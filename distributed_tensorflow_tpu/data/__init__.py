@@ -1,0 +1,4 @@
+from distributed_tensorflow_tpu.data.datasets import DataSet, read_data_sets
+from distributed_tensorflow_tpu.data.pipeline import prefetch_to_device
+
+__all__ = ["DataSet", "read_data_sets", "prefetch_to_device"]

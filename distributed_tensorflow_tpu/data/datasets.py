@@ -1,0 +1,193 @@
+"""Dataset objects with the reference's input-data semantics.
+
+The reference's input pipeline (``MNISTDist.py:167,178``) is
+``input_data.read_data_sets(FLAGS.data_dir, one_hot=True)`` + per-worker
+``mnist.train.next_batch(batch_size)``: every worker loads the full dataset
+and draws its own independently-shuffled minibatches (no inter-worker
+sharding). ``DataSet``/``read_data_sets`` reproduce that API and semantics;
+``DataSet.shard`` adds the TPU-idiomatic alternative (disjoint shards for
+synchronous data-parallel).
+
+Sources, in priority order:
+1. IDX files in ``data_dir`` (what the TF tutorial downloader leaves there)
+2. CIFAR-10 python pickle batches in ``data_dir`` (for dataset="cifar10")
+3. deterministic procedural fallback (offline environments; see synthetic.py)
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from distributed_tensorflow_tpu.data import synthetic
+from distributed_tensorflow_tpu.data.idx import find_idx_file, read_idx
+
+_MNIST_FILES = {
+    "train_images": "train-images-idx3-ubyte",
+    "train_labels": "train-labels-idx1-ubyte",
+    "test_images": "t10k-images-idx3-ubyte",
+    "test_labels": "t10k-labels-idx1-ubyte",
+}
+
+SYNTHETIC_TRAIN = 20000
+SYNTHETIC_TEST = 2000
+
+
+class DataSet:
+    """One split. ``next_batch`` matches the reference tutorial DataSet:
+    shuffled epochs, each worker shuffles independently from its seed."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray, *, one_hot: bool = True,
+                 num_classes: int = 10, seed: int = 0):
+        assert images.shape[0] == labels.shape[0]
+        self.images = images
+        self.labels_int = labels.astype(np.int64)
+        self.one_hot = one_hot
+        self.num_classes = num_classes
+        self._rng = np.random.default_rng(seed)
+        self._order = self._rng.permutation(len(images))
+        self._pos = 0
+        self.epochs_completed = 0
+
+    @property
+    def num_examples(self) -> int:
+        return len(self.images)
+
+    @property
+    def labels(self) -> np.ndarray:
+        if self.one_hot:
+            out = np.zeros((len(self.labels_int), self.num_classes), np.float32)
+            out[np.arange(len(self.labels_int)), self.labels_int] = 1.0
+            return out
+        return self.labels_int
+
+    def next_batch(self, batch_size: int) -> tuple[np.ndarray, np.ndarray]:
+        """Sequential walk over a shuffled order, reshuffling each epoch —
+        the tutorial ``DataSet.next_batch`` behavior the reference's hot loop
+        calls (``MNISTDist.py:178``)."""
+        if self.num_examples == 0:
+            raise ValueError("next_batch on an empty DataSet (0 examples)")
+        idx = np.empty(batch_size, dtype=np.int64)
+        filled = 0
+        while filled < batch_size:
+            take = min(batch_size - filled, len(self._order) - self._pos)
+            idx[filled : filled + take] = self._order[self._pos : self._pos + take]
+            self._pos += take
+            filled += take
+            if self._pos >= len(self._order):
+                self._order = self._rng.permutation(len(self.images))
+                self._pos = 0
+                self.epochs_completed += 1
+        xs = self.images[idx]
+        if self.one_hot:
+            ys = np.zeros((batch_size, self.num_classes), np.float32)
+            ys[np.arange(batch_size), self.labels_int[idx]] = 1.0
+        else:
+            ys = self.labels_int[idx]
+        return xs, ys
+
+    def shard(self, index: int, count: int) -> "DataSet":
+        """Disjoint contiguous shard — the sync-DP alternative to the
+        reference's everyone-loads-everything scheme."""
+        sl = slice(index * self.num_examples // count,
+                   (index + 1) * self.num_examples // count)
+        return DataSet(self.images[sl], self.labels_int[sl], one_hot=self.one_hot,
+                       num_classes=self.num_classes, seed=index)
+
+
+@dataclass
+class Datasets:
+    train: DataSet
+    test: DataSet
+    validation: DataSet | None = None
+    source: str = "synthetic"  # "idx" | "cifar" | "synthetic"
+    meta: dict = field(default_factory=dict)
+
+
+def _load_mnist_idx(data_dir: str) -> dict[str, np.ndarray] | None:
+    paths = {k: find_idx_file(data_dir, v) for k, v in _MNIST_FILES.items()}
+    if not all(paths.values()):
+        return None
+    out = {k: read_idx(p) for k, p in paths.items()}
+    return out
+
+
+def _load_cifar10(data_dir: str):
+    """CIFAR-10 python-version pickle batches (data_batch_1..5, test_batch)."""
+    def _find(name):
+        for root in (data_dir, os.path.join(data_dir, "cifar-10-batches-py")):
+            p = os.path.join(root, name)
+            if os.path.exists(p):
+                return p
+        return None
+
+    train_paths = [_find(f"data_batch_{i}") for i in range(1, 6)]
+    test_path = _find("test_batch")
+    if not all(train_paths) or test_path is None:
+        return None
+
+    def _read(p):
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return x.astype(np.float32) / 255.0, np.asarray(d[b"labels"], np.int64)
+
+    xs, ys = zip(*[_read(p) for p in train_paths])
+    tx, ty = _read(test_path)
+    return np.concatenate(xs), np.concatenate(ys), tx, ty
+
+
+def read_data_sets(
+    data_dir: str,
+    one_hot: bool = True,
+    dataset: str = "mnist",
+    seed: int = 0,
+    validation_size: int = 0,
+) -> Datasets:
+    """API parity with the tutorial loader the reference imports
+    (``MNISTDist.py:11,167``), extended with ``dataset`` selection:
+    "mnist" | "fashion_mnist" (same IDX format) | "cifar10".
+    Falls back to procedural data when files are absent (offline envs)."""
+    dataset = dataset.lower().replace("-", "_")
+    if dataset in ("mnist", "fashion_mnist"):
+        raw = _load_mnist_idx(data_dir) if data_dir and os.path.isdir(data_dir) else None
+        if raw is not None:
+            trx = raw["train_images"].reshape(-1, 784).astype(np.float32) / 255.0
+            trl = raw["train_labels"].astype(np.int64)
+            tex = raw["test_images"].reshape(-1, 784).astype(np.float32) / 255.0
+            tel = raw["test_labels"].astype(np.int64)
+            source = "idx"
+        else:
+            trx, trl = synthetic.synthetic_digits(SYNTHETIC_TRAIN, seed=seed)
+            tex, tel = synthetic.synthetic_digits(SYNTHETIC_TEST, seed=seed + 1)
+            source = "synthetic"
+        meta = {"image_size": 28, "channels": 1, "num_classes": 10, "flat": True}
+    elif dataset == "cifar10":
+        raw = _load_cifar10(data_dir) if data_dir and os.path.isdir(data_dir) else None
+        if raw is not None:
+            trx, trl, tex, tel = raw
+            source = "cifar"
+        else:
+            trx, trl = synthetic.synthetic_cifar(SYNTHETIC_TRAIN, seed=seed)
+            tex, tel = synthetic.synthetic_cifar(SYNTHETIC_TEST, seed=seed + 1)
+            source = "synthetic"
+        meta = {"image_size": 32, "channels": 3, "num_classes": 10, "flat": False}
+    else:
+        raise ValueError(f"unknown dataset {dataset!r}")
+
+    val = None
+    if validation_size:
+        val = DataSet(trx[:validation_size], trl[:validation_size],
+                      one_hot=one_hot, seed=seed + 2)
+        trx, trl = trx[validation_size:], trl[validation_size:]
+
+    return Datasets(
+        train=DataSet(trx, trl, one_hot=one_hot, seed=seed),
+        test=DataSet(tex, tel, one_hot=one_hot, seed=seed + 1),
+        validation=val,
+        source=source,
+        meta=meta,
+    )

@@ -1,0 +1,4 @@
+from distributed_tensorflow_tpu.models.cnn import DeepCNN
+from distributed_tensorflow_tpu.models.registry import get_model, register_model
+
+__all__ = ["DeepCNN", "get_model", "register_model"]

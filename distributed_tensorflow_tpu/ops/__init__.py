@@ -1,0 +1,17 @@
+from distributed_tensorflow_tpu.ops.nn import (
+    conv2d,
+    maxpool2d,
+    dense,
+    dropout,
+    softmax_cross_entropy,
+    accuracy,
+)
+
+__all__ = [
+    "conv2d",
+    "maxpool2d",
+    "dense",
+    "dropout",
+    "softmax_cross_entropy",
+    "accuracy",
+]

@@ -1,0 +1,115 @@
+"""Neural-net op layer: the XLA:TPU equivalents of the reference's C++ kernels.
+
+The reference calls TensorFlow's C++ kernels — Conv2D/BiasAdd/Relu
+(``MNISTDist.py:52-56``), MaxPool (``:59-62``), MatMul (``:82-89``),
+SoftmaxCrossEntropyWithLogits (``:148``). Here every op is a pure function
+lowered by XLA onto the TPU's MXU (convs/matmuls) and VPU (elementwise),
+letting the compiler fuse bias+relu into the conv rather than hand-scheduling.
+
+Layout choices are TPU-first: NHWC activations and HWIO kernels (the
+reference's layout too, which XLA:TPU handles natively), channels as the
+minor dimension so tiles map onto the (8,128) vregs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# dimension_numbers matching the reference's NHWC/HWIO convention
+# (tf.nn.conv2d default, MNISTDist.py:54)
+_CONV_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def conv2d(x, w, b=None, strides: int = 1, *, compute_dtype=None):
+    """SAME-padded conv + bias + ReLU (reference ``conv2d``, MNISTDist.py:52-56).
+
+    One ``lax.conv_general_dilated`` call; XLA fuses the bias-add and ReLU
+    into the conv epilogue on TPU. ``compute_dtype=jnp.bfloat16`` runs the
+    MXU in bf16 with f32 accumulation (preferred_element_type) — params stay
+    in f32 master copies.
+    """
+    in_dtype = x.dtype
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        w = w.astype(compute_dtype)
+    y = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(strides, strides),
+        padding="SAME",
+        dimension_numbers=_CONV_DIMS,
+        preferred_element_type=jnp.float32,
+    )
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    y = jax.nn.relu(y)
+    return y.astype(in_dtype) if compute_dtype is not None else y
+
+
+def maxpool2d(x, k: int = 2):
+    """k×k max-pool, stride k, SAME padding (reference ``maxpool2d``, MNISTDist.py:59-62)."""
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, k, k, 1),
+        window_strides=(1, k, k, 1),
+        padding="SAME",
+    )
+
+
+def dense(x, w, b=None, *, compute_dtype=None):
+    """x @ w + b (reference FC layers, MNISTDist.py:83,89). MXU matmul, f32 accumulate."""
+    if compute_dtype is not None:
+        y = jnp.dot(
+            x.astype(compute_dtype),
+            w.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+    else:
+        y = jnp.dot(x, w)
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    return y
+
+
+def dropout(x, keep_prob, rng, *, deterministic: bool = False):
+    """Inverted dropout (reference ``tf.nn.dropout``, MNISTDist.py:86).
+
+    ``keep_prob`` may be a traced scalar (mirrors the reference's
+    ``keep_prob`` placeholder, MNISTDist.py:115). ``deterministic=True``
+    (or rng None) is the eval path — identity, like feeding 1.0.
+    """
+    if deterministic or rng is None:
+        return x
+    keep_prob = jnp.asarray(keep_prob, x.dtype)
+    mask = jax.random.bernoulli(rng, keep_prob, x.shape)
+    # guard against keep_prob == 0 division (XLA-safe select)
+    scale = jnp.where(keep_prob > 0, 1.0 / jnp.maximum(keep_prob, 1e-8), 0.0)
+    return jnp.where(mask, x * scale, jnp.zeros_like(x))
+
+
+def softmax_cross_entropy(logits, labels_onehot):
+    """Mean softmax cross-entropy over the batch (reference cost, MNISTDist.py:148).
+
+    Numerically-stable log-softmax form; XLA fuses the whole reduction.
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    per_example = -jnp.sum(labels_onehot.astype(jnp.float32) * logp, axis=-1)
+    return jnp.mean(per_example)
+
+
+def accuracy(logits, labels_onehot):
+    """Minibatch argmax-equality accuracy (reference, MNISTDist.py:152-153)."""
+    pred = jnp.argmax(logits, axis=-1)
+    true = jnp.argmax(labels_onehot, axis=-1)
+    return jnp.mean((pred == true).astype(jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes",))
+def one_hot(labels, num_classes: int = 10):
+    return jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)

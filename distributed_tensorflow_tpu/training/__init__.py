@@ -1,0 +1,19 @@
+from distributed_tensorflow_tpu.training.train_state import (
+    TrainState,
+    create_train_state,
+    make_train_step,
+    make_eval_step,
+    sgd,
+    adam,
+    get_optimizer,
+)
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "make_eval_step",
+    "sgd",
+    "adam",
+    "get_optimizer",
+]

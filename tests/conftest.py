@@ -1,0 +1,16 @@
+"""Test env: force CPU with 8 virtual devices BEFORE jax initializes.
+
+This is the distributed-without-a-cluster strategy (SURVEY.md §4): mesh +
+collective code paths run on a simulated 8-device host, so CI needs no TPU.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")

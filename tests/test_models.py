@@ -1,0 +1,91 @@
+"""Model parity: shapes, parameter count (~3.27M), init distributions.
+
+Reference: conv_net (MNISTDist.py:66-90), weights/biases dicts (:117-141),
+weight_variable/bias_variable (:42-49).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models import DeepCNN, get_model
+
+
+@pytest.fixture(scope="module")
+def model():
+    return DeepCNN()
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.key(0))
+
+
+def test_registry():
+    m = get_model("deep_cnn")
+    assert isinstance(m, DeepCNN)
+
+
+def test_param_shapes(params):
+    w, b = params["weights"], params["biases"]
+    assert w["wc1"].shape == (5, 5, 1, 32)
+    assert w["wc2"].shape == (5, 5, 32, 64)
+    assert w["wd1"].shape == (7 * 7 * 64, 1024)
+    assert w["out"].shape == (1024, 10)
+    assert b["bc1"].shape == (32,)
+    assert b["bc2"].shape == (64,)
+    assert b["bd1"].shape == (1024,)
+    assert b["out"].shape == (10,)
+
+
+def test_param_count(model, params):
+    # reference model is ~3.27M params (SURVEY.md C6)
+    n = model.num_params(params)
+    expected = (
+        5 * 5 * 1 * 32 + 5 * 5 * 32 * 64 + 3136 * 1024 + 1024 * 10
+        + 32 + 64 + 1024 + 10
+    )
+    assert n == expected
+    assert 3_270_000 < n < 3_280_000
+
+
+def test_init_distributions(params):
+    wd1 = np.asarray(params["weights"]["wd1"])
+    # truncated normal sigma=0.1: bounded at +-0.2, std close to 0.1 (slightly less)
+    assert np.abs(wd1).max() <= 0.2 + 1e-6
+    assert 0.07 < wd1.std() < 0.11
+    np.testing.assert_allclose(np.asarray(params["biases"]["bd1"]), 0.1)
+
+
+def test_forward_shape(model, params):
+    x = jnp.ones((4, 784))
+    logits = model.apply(params, x)
+    assert logits.shape == (4, 10)
+
+
+def test_forward_accepts_image_shape(model, params):
+    # reference reshapes [-1, 28,28,1] internally (MNISTDist.py:68)
+    x = jnp.ones((4, 28, 28, 1))
+    logits = model.apply(params, x)
+    assert logits.shape == (4, 10)
+
+
+def test_forward_deterministic_eval(model, params):
+    x = jax.random.normal(jax.random.key(1), (2, 784))
+    a = model.apply(params, x)
+    b = model.apply(params, x)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dropout_active_in_train_mode(model, params):
+    x = jax.random.normal(jax.random.key(1), (2, 784))
+    a = model.apply(params, x, keep_prob=0.5, rng=jax.random.key(2), train=True)
+    b = model.apply(params, x, keep_prob=0.5, rng=jax.random.key(3), train=True)
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_fashion_mnist_drop_in(model):
+    # identical graph on a 28x28 grayscale drop-in: same model class works
+    m2 = DeepCNN(image_size=28, num_classes=10)
+    assert m2.flat_dim == model.flat_dim

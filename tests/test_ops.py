@@ -1,0 +1,96 @@
+"""Op-layer correctness: shapes, semantics, numeric grads.
+
+Reference semantics under test: conv2d wrapper (MNISTDist.py:52-56),
+maxpool2d (:59-62), softmax CE cost (:148), accuracy graph (:152-153),
+dropout (:86).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.ops import nn
+
+
+def test_conv2d_same_shape_stride1():
+    x = jnp.ones((2, 28, 28, 1))
+    w = jnp.ones((5, 5, 1, 32)) * 0.01
+    b = jnp.zeros((32,))
+    y = nn.conv2d(x, w, b)
+    assert y.shape == (2, 28, 28, 32)
+
+
+def test_conv2d_bias_relu():
+    x = jnp.ones((1, 4, 4, 1))
+    w = jnp.zeros((3, 3, 1, 2))
+    b = jnp.array([1.5, -2.0])
+    y = nn.conv2d(x, w, b)
+    # conv output is 0, bias then relu: max(b, 0)
+    np.testing.assert_allclose(np.asarray(y[0, 0, 0]), [1.5, 0.0])
+
+
+def test_maxpool_downsamples():
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y = nn.maxpool2d(x, k=2)
+    assert y.shape == (1, 2, 2, 1)
+    np.testing.assert_allclose(np.asarray(y).squeeze(), [[5, 7], [13, 15]])
+
+
+def test_maxpool_same_padding_odd():
+    # 28 -> 14 -> 7 -> SAME pads 7 -> 4 (the reference's 7x7 feature map path)
+    x = jnp.ones((1, 7, 7, 1))
+    y = nn.maxpool2d(x, k=2)
+    assert y.shape == (1, 4, 4, 1)
+
+
+def test_softmax_ce_matches_manual():
+    logits = jnp.array([[2.0, 1.0, 0.1], [0.5, 2.5, 0.0]])
+    onehot = jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]])
+    got = nn.softmax_cross_entropy(logits, onehot)
+    p = jax.nn.softmax(logits)
+    want = -np.mean(np.log(np.asarray(p)[[0, 1], [0, 1]]))
+    np.testing.assert_allclose(float(got), want, rtol=1e-4)
+
+
+def test_softmax_ce_grad_numeric():
+    onehot = jnp.array([[0.0, 1.0, 0.0]])
+
+    def f(logits):
+        return nn.softmax_cross_entropy(logits, onehot)
+
+    logits = jnp.array([[0.3, -0.2, 0.9]])
+    g = jax.grad(f)(logits)
+    eps = 1e-4
+    for i in range(3):
+        d = jnp.zeros_like(logits).at[0, i].set(eps)
+        num = (f(logits + d) - f(logits - d)) / (2 * eps)
+        np.testing.assert_allclose(float(g[0, i]), float(num), atol=1e-3)
+
+
+def test_accuracy():
+    logits = jnp.array([[1.0, 2.0], [3.0, 0.0], [0.0, 1.0], [5.0, 1.0]])
+    onehot = jnp.array([[0.0, 1.0], [1.0, 0.0], [1.0, 0.0], [0.0, 1.0]])
+    assert float(nn.accuracy(logits, onehot)) == pytest.approx(0.5)
+
+
+def test_dropout_eval_identity():
+    x = jnp.ones((4, 8))
+    y = nn.dropout(x, 0.75, jax.random.key(0), deterministic=True)
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_dropout_train_scales():
+    x = jnp.ones((1000, 100))
+    y = nn.dropout(x, 0.75, jax.random.key(0), deterministic=False)
+    kept = np.asarray(y) > 0
+    assert 0.70 < kept.mean() < 0.80  # ~keep_prob fraction kept
+    np.testing.assert_allclose(np.asarray(y)[kept], 1.0 / 0.75, rtol=1e-6)
+    # expectation preserved
+    assert abs(float(y.mean()) - 1.0) < 0.02
+
+
+def test_dropout_keep_prob_one_is_identity_valued():
+    x = jax.random.normal(jax.random.key(1), (32, 16))
+    y = nn.dropout(x, 1.0, jax.random.key(2), deterministic=False)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6)

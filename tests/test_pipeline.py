@@ -1,0 +1,61 @@
+"""Prefetch pipeline: ordering, error propagation, clean shutdown."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.pipeline import batch_iterator, prefetch_to_device
+from distributed_tensorflow_tpu.data.datasets import DataSet
+
+
+def test_prefetch_preserves_order_and_values():
+    batches = [(np.full((2, 2), i), np.array([i])) for i in range(5)]
+    out = list(prefetch_to_device(iter(batches)))
+    assert len(out) == 5
+    for i, (x, y) in enumerate(out):
+        np.testing.assert_allclose(np.asarray(x), i)
+
+
+def test_prefetch_propagates_worker_exception():
+    def gen():
+        yield (np.zeros(1), np.zeros(1))
+        raise RuntimeError("boom in loader")
+
+    it = prefetch_to_device(gen())
+    next(it)
+    with pytest.raises(RuntimeError, match="boom in loader"):
+        next(it)
+
+
+def test_prefetch_close_terminates_worker():
+    before = threading.active_count()
+
+    def infinite():
+        i = 0
+        while True:
+            yield (np.full(4, i), np.zeros(1))
+            i += 1
+
+    it = prefetch_to_device(infinite(), size=2)
+    next(it)
+    it.close()
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.02)
+    assert threading.active_count() <= before
+
+
+def test_empty_dataset_next_batch_raises():
+    ds = DataSet(np.zeros((0, 4), np.float32), np.zeros(0, np.int64))
+    with pytest.raises(ValueError, match="empty"):
+        ds.next_batch(4)
+
+
+def test_batch_iterator_shapes():
+    ds = DataSet(np.arange(20, dtype=np.float32).reshape(10, 2),
+                 np.zeros(10, np.int64), one_hot=True)
+    it = batch_iterator(ds, 4)
+    x, y = next(it)
+    assert x.shape == (4, 2) and y.shape == (4, 10)
