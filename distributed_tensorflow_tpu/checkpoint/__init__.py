@@ -1,0 +1,13 @@
+from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+    Checkpointer,
+    save_checkpoint,
+    restore_latest,
+    latest_checkpoint,
+)
+
+__all__ = [
+    "Checkpointer",
+    "save_checkpoint",
+    "restore_latest",
+    "latest_checkpoint",
+]

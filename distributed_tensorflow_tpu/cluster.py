@@ -1,0 +1,87 @@
+"""Cluster bootstrap: the reference's ClusterSpec/Server layer, TPU-native.
+
+Reference behavior (``MNISTDist.py:94-107``): split ``--ps_hosts`` /
+``--worker_hosts``, build a two-job ClusterSpec, start a gRPC server for the
+local task, then demux on role (ps blocks in ``server.join()``; worker
+builds the graph). The same script runs once per task — SPMD by hand.
+
+TPU-native mapping:
+- sync mode, multi-host: ``jax.distributed.initialize`` — worker 0's host
+  is the coordinator (derived from ``--worker_hosts``); all hosts join one
+  global device mesh; there is no ps job at all.
+- ps-emulation mode: the host lists keep their exact reference meaning —
+  ps tasks run the parameter service (the ``server.join()`` equivalent),
+  workers train against it (see ``parallel/ps_emulation.py``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ClusterSpec:
+    """Static job->hosts membership (tf.train.ClusterSpec parity)."""
+
+    jobs: dict[str, list[str]] = field(default_factory=dict)
+
+    @classmethod
+    def from_flags(cls, FLAGS) -> "ClusterSpec":
+        ps = [h for h in FLAGS.ps_hosts.split(",") if h]
+        workers = [h for h in FLAGS.worker_hosts.split(",") if h]
+        return cls({"ps": ps, "worker": workers})
+
+    @property
+    def ps_hosts(self) -> list[str]:
+        return self.jobs.get("ps", [])
+
+    @property
+    def worker_hosts(self) -> list[str]:
+        return self.jobs.get("worker", [])
+
+    def task_address(self, job: str, index: int) -> str:
+        hosts = self.jobs.get(job, [])
+        if not 0 <= index < len(hosts):
+            raise ValueError(
+                f"task_index {index} out of range for job {job!r} with "
+                f"{len(hosts)} hosts"
+            )
+        return hosts[index]
+
+    def num_tasks(self, job: str) -> int:
+        return len(self.jobs.get(job, []))
+
+
+def resolve_mode(FLAGS) -> str:
+    """Demux --mode=auto: reference-style role launch (--ps_hosts set) means
+    ps emulation; otherwise sync DP over local devices."""
+    mode = FLAGS.mode
+    if mode != "auto":
+        return mode
+    if FLAGS.ps_hosts:
+        return "ps"
+    if len([h for h in FLAGS.worker_hosts.split(",") if h]) > 1:
+        return "sync"
+    return "local"
+
+
+def maybe_initialize_distributed(cluster: ClusterSpec, task_index: int) -> bool:
+    """Multi-host sync mode: join the JAX coordination service over DCN.
+
+    Worker 0's host acts as coordinator (the role the chief's master service
+    plays in the reference). Single-host runs skip this entirely. Returns
+    True if distributed init happened.
+    """
+    workers = cluster.worker_hosts
+    if len(workers) <= 1:
+        return False
+    import jax
+
+    coordinator = workers[0]
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=len(workers),
+        process_id=task_index,
+    )
+    return True

@@ -1,0 +1,151 @@
+"""Flag system with the reference's ``tf.app.flags`` surface.
+
+The reference's public interface is 10 flags + ``tf.app.run()``
+(``MNISTDist.py:13-31,197-198``). This module reproduces that API —
+``DEFINE_string/integer/float/boolean``, a lazily-parsed ``FLAGS``
+singleton, and ``run(main)`` — over argparse, with zero TF dependency.
+
+CLI compatibility is a hard requirement (BASELINE.json): the same launch
+scripts that address GPU workers must address TPU VMs, so ``--job_name``,
+``--task_index``, ``--ps_hosts``, ``--worker_hosts`` keep their exact
+meanings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Callable
+
+
+class _FlagValues:
+    """Lazy-parsing flag namespace (attribute access parses argv once),
+    mirroring the TF-0.x FLAGS behavior the reference relies on."""
+
+    def __init__(self):
+        self.__dict__["_defs"] = {}  # name -> (type_fn, default, help)
+        self.__dict__["_values"] = None
+        self.__dict__["_extra_argv"] = []
+
+    def _define(self, name: str, default, help_str: str, type_fn: Callable):
+        if self._values is not None:
+            # late definition after parse: make it visible with its default
+            self._values[name] = default
+        self._defs[name] = (type_fn, default, help_str)
+
+    def _parse(self, argv=None):
+        parser = argparse.ArgumentParser(allow_abbrev=False)
+        for name, (type_fn, default, help_str) in self._defs.items():
+            if type_fn is bool:
+                parser.add_argument(
+                    f"--{name}",
+                    type=_parse_bool,
+                    default=default,
+                    nargs="?",
+                    const=True,
+                    help=help_str,
+                )
+            else:
+                parser.add_argument(f"--{name}", type=type_fn, default=default, help=help_str)
+        ns, extra = parser.parse_known_args(
+            sys.argv[1:] if argv is None else list(argv)
+        )
+        self.__dict__["_values"] = vars(ns)
+        self.__dict__["_extra_argv"] = extra
+        return extra
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if self._values is None:
+            self._parse()
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(f"unknown flag {name!r}") from None
+
+    def __setattr__(self, name: str, value: Any):
+        if self._values is None:
+            self._parse()
+        self._values[name] = value
+
+    def _reset(self):
+        """Testing hook: forget parsed values (definitions stay)."""
+        self.__dict__["_values"] = None
+        self.__dict__["_extra_argv"] = []
+
+
+def _parse_bool(s):
+    if isinstance(s, bool):
+        return s
+    if str(s).lower() in ("1", "true", "t", "yes", "y"):
+        return True
+    if str(s).lower() in ("0", "false", "f", "no", "n"):
+        return False
+    raise argparse.ArgumentTypeError(f"invalid boolean {s!r}")
+
+
+FLAGS = _FlagValues()
+
+
+def DEFINE_string(name: str, default: str | None, help_str: str = ""):
+    FLAGS._define(name, default, help_str, str)
+
+
+def DEFINE_integer(name: str, default: int | None, help_str: str = ""):
+    FLAGS._define(name, default, help_str, int)
+
+
+def DEFINE_float(name: str, default: float | None, help_str: str = ""):
+    FLAGS._define(name, default, help_str, float)
+
+
+def DEFINE_boolean(name: str, default: bool | None, help_str: str = ""):
+    FLAGS._define(name, default, help_str, bool)
+
+
+DEFINE_bool = DEFINE_boolean
+
+
+def run(main: Callable | None = None, argv=None):
+    """``tf.app.run`` parity (MNISTDist.py:198): parse flags, call
+    ``main(unparsed_argv)``, exit with its return code."""
+    extra = FLAGS._parse(argv)
+    main = main or sys.modules["__main__"].main
+    sys.exit(main([sys.argv[0]] + extra))
+
+
+def define_reference_flags():
+    """The reference's exact 10-flag surface (MNISTDist.py:13-31) plus this
+    build's extensions. Idempotent."""
+    if "job_name" in FLAGS._defs:
+        return
+    # --- reference flags, same names/defaults/meanings ---
+    DEFINE_string("data_dir", "/tmp/mnist-data", "Directory for string mnist data")
+    DEFINE_string("ps_hosts", "", "Comma-separated list of hostname:port pairs")
+    DEFINE_string("worker_hosts", "", "Comma-separated list of hostname:port pairs")
+    DEFINE_string("job_name", "", "One of 'ps', 'worker'")
+    DEFINE_integer("task_index", 0, "Index of task within the job")
+    DEFINE_integer("hidden_units", 100, "Number of units in the hidden layer of the NN")
+    DEFINE_integer("batch_size", 128, "Training batchsize")
+    DEFINE_integer("training_iter", 10000, "Training iteration")
+    DEFINE_float("learning_rate", 0.001, "Learning rate")
+    DEFINE_integer("display_step", 100, "display step")
+    # --- build extensions (TPU-native modes and configs) ---
+    DEFINE_string("mode", "auto", "Parallel mode: auto|local|sync|ps. auto = "
+                  "'ps' roles when --ps_hosts is set (reference semantics), "
+                  "else sync DP over all local devices")
+    DEFINE_string("model", "deep_cnn", "Model architecture: deep_cnn|resnet20")
+    DEFINE_string("dataset", "mnist", "Dataset: mnist|fashion_mnist|cifar10")
+    DEFINE_string("optimizer", "sgd", "Optimizer: sgd|momentum|adam (reference: sgd)")
+    DEFINE_float("keep_prob", 0.75, "Dropout keep probability during training. "
+                 "The reference defines DROPOUT=0.75 but feeds 1.0 (disabled); "
+                 "this build applies it")
+    DEFINE_string("logdir", "/tmp/train_logs", "Checkpoint/metrics directory (reference default)")
+    DEFINE_integer("save_model_secs", 600, "Checkpoint cadence in seconds (reference default)")
+    DEFINE_integer("seed", 0, "PRNG seed")
+    DEFINE_boolean("bf16", False, "Run matmuls/convs in bfloat16 on the MXU")
+    DEFINE_boolean("test_eval", True, "Evaluate on the test split at the end "
+                   "(the reference never does; targets require it)")
+    DEFINE_boolean("shard_data", False, "Give each worker a disjoint data shard "
+                   "(reference: every worker samples the full dataset)")

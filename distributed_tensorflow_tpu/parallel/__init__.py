@@ -1,0 +1,19 @@
+from distributed_tensorflow_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    batch_sharding,
+    replicated_sharding,
+)
+from distributed_tensorflow_tpu.parallel.data_parallel import (
+    make_dp_train_step,
+    shard_batch,
+)
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "make_dp_train_step",
+    "shard_batch",
+]

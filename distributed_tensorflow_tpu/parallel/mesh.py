@@ -1,0 +1,69 @@
+"""Device mesh construction — the TPU-native replacement for ClusterSpec.
+
+The reference describes its cluster as static host:port lists fed to
+``tf.train.ClusterSpec`` (``MNISTDist.py:94-98``); placement is a device
+*function* (``replica_device_setter``, ``:110-111``). On TPU the analogous
+objects are a ``jax.sharding.Mesh`` over the chips and ``NamedSharding``s
+naming which mesh axes each array is split over. Collectives compiled
+against mesh axes ride ICI within a slice (DCN across slices) — no
+user-visible server, no Send/Recv graph edges.
+
+Axis convention:
+    "data"  — batch dimension (data parallelism; the reference's only mode)
+    "model" — reserved for tensor parallelism (open design axis; unused by
+              the MNIST-parity configs but kept first-class so wider models
+              can shard without reshaping the framework)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape: how many ways to split batch vs model dims."""
+
+    data: int = -1  # -1 = all remaining devices
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int]:
+        model = self.model
+        data = self.data if self.data != -1 else n_devices // model
+        if data * model != n_devices:
+            raise ValueError(
+                f"mesh {data}x{model} does not cover {n_devices} devices"
+            )
+        return data, model
+
+
+def make_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
+    """Build a ("data", "model") mesh over the available devices.
+
+    Device order follows ``jax.devices()`` which enumerates chips in
+    ICI-neighbor order on TPU slices, so the data axis maps onto physical
+    rings and ``psum`` stays on ICI.
+    """
+    if devices is None:
+        devices = jax.devices()
+    spec = spec or MeshSpec()
+    data, model = spec.resolve(len(devices))
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Params/state: full copy on every device (pure DP)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, batch_axes: int = 1) -> NamedSharding:
+    """Inputs: leading dim split over the data axis, rest replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (batch_axes - 1))))

@@ -1,0 +1,378 @@
+"""Asynchronous parameter-server emulation — the reference's topology.
+
+The reference's distribution model (``MNISTDist.py:94-111,174-188``):
+Variables live round-robin on ps tasks (``replica_device_setter``), each
+worker independently pulls params, computes grads on its own minibatch, and
+pushes them back where ``ApplyGradientDescent`` runs *on the ps* — no
+synchronization between workers (stale-gradient async SGD), termination on
+a shared global step.
+
+TPU-native emulation: compute (forward/backward) is a jitted XLA function
+on the worker's TPU chips; parameter state and the SGD update live on the
+ps *hosts* (numpy, like TF's ps-side C++ kernels ran on CPU in the
+reference deployment). Transport is a small length-prefixed-pickle TCP
+protocol over DCN — playing the role of TF's gRPC Send/Recv. Sharding is
+round-robin over parameter leaves across ps tasks, the
+``replica_device_setter`` policy (``MNISTDist.py:110-111``).
+
+Chief semantics (``MNISTDist.py:159,169-170``): worker 0 initializes (or
+restores a checkpoint) and pushes the initial params to the ps tasks;
+non-chief workers wait until the ps reports initialized. The shared
+global_step lives on ps task 0 and increments once per applied push, so
+``training_iter`` bounds TOTAL steps across all workers, exactly like the
+reference (``:173,188``).
+
+This transport is an in-cluster emulation protocol (pickle): run it only
+on trusted training networks, as with TF's unauthenticated gRPC runtime.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_tpu.checkpoint import Checkpointer
+
+_LEN = struct.Struct(">Q")
+
+
+# ---------------------------------------------------------------- protocol
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket):
+    header = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(header)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return bytes(buf)
+
+
+# ---------------------------------------------------------------- sharding
+
+# one shared path-key scheme with the checkpoint writer (utils/pytree.py)
+from distributed_tensorflow_tpu.utils.pytree import (  # noqa: E402
+    flatten_pytree as flatten_params,
+    unflatten_pytree as unflatten_params,
+)
+
+
+def assign_shards(keys: list[str], num_ps: int) -> dict[str, int]:
+    """Round-robin leaves over ps tasks in sorted-key order — the
+    replica_device_setter placement policy (MNISTDist.py:110-111)."""
+    return {k: i % num_ps for i, k in enumerate(sorted(keys))}
+
+
+# ---------------------------------------------------------------- server
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        ps: PSServer = self.server.ps  # type: ignore[attr-defined]
+        try:
+            while True:
+                msg = _recv_msg(self.request)
+                _send_msg(self.request, ps.dispatch(msg))
+        except (ConnectionError, EOFError):
+            pass
+
+
+class _ThreadedTCP(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PSServer:
+    """One parameter-server task: owns a shard of param leaves + (task 0
+    only) the shared global step. Applies vanilla SGD on push — the
+    reference's ps-side ApplyGradientDescent (MNISTDist.py:149)."""
+
+    def __init__(self, task_index: int, bind_address: str):
+        self.task_index = task_index
+        host, port = bind_address.rsplit(":", 1)
+        self._lock = threading.Lock()
+        self.params: dict[str, np.ndarray] = {}
+        self.initialized = False
+        self.global_step = 0  # authoritative only on task 0
+        self._shutdown = threading.Event()
+        self._server = _ThreadedTCP((host, int(port)), _Handler)
+        self._server.ps = self  # type: ignore[attr-defined]
+
+    @property
+    def address(self) -> str:
+        h, p = self._server.server_address[:2]
+        return f"{h}:{p}"
+
+    def dispatch(self, msg: dict):
+        op = msg.get("op")
+        with self._lock:
+            if op == "ping":
+                return {"ok": True, "task": self.task_index}
+            if op == "init_shard":
+                self.params = {k: np.array(v, dtype=np.float32)
+                               for k, v in msg["params"].items()}
+                self.initialized = True
+                return {"ok": True}
+            if op == "pull":
+                if not self.initialized:
+                    return {"ok": False, "uninitialized": True}
+                # snapshot under the lock: the response is pickled after the
+                # lock is released, and concurrent pushes mutate these
+                # arrays in place — copying prevents serving torn tensors
+                return {"ok": True,
+                        "params": {k: v.copy() for k, v in self.params.items()},
+                        "global_step": self.global_step}
+            if op == "push_grads":
+                if not self.initialized:
+                    return {"ok": False, "uninitialized": True}
+                lr = float(msg["lr"])
+                for k, g in msg["grads"].items():
+                    if k in self.params:
+                        self.params[k] -= lr * np.asarray(g, dtype=np.float32)
+                if msg.get("count_step", False):
+                    self.global_step += 1
+                return {"ok": True, "global_step": self.global_step}
+            if op == "get_step":
+                return {"ok": True, "global_step": self.global_step}
+            if op == "set_step":
+                self.global_step = int(msg["global_step"])
+                return {"ok": True}
+            if op == "shutdown":
+                self._shutdown.set()
+                return {"ok": True}
+            return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def serve_forever(self):
+        """server.join() parity (MNISTDist.py:105-106): block until a
+        shutdown message arrives (or the process is killed)."""
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        self._shutdown.wait()
+        self._server.shutdown()
+
+    def start_background(self) -> threading.Thread:
+        """Testing hook: serve on a daemon thread."""
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def close(self):
+        self._shutdown.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# ---------------------------------------------------------------- client
+
+class PSClient:
+    """Worker-side connection pool to every ps task."""
+
+    def __init__(self, addresses: list[str], connect_timeout: float = 60.0):
+        self.addresses = addresses
+        self._socks: list[socket.socket | None] = [None] * len(addresses)
+        self._timeout = connect_timeout
+        self._lock = threading.Lock()
+
+    def _sock(self, i: int) -> socket.socket:
+        if self._socks[i] is None:
+            host, port = self.addresses[i].rsplit(":", 1)
+            deadline = time.time() + self._timeout
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)), timeout=10)
+                    s.settimeout(None)
+                    self._socks[i] = s
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise ConnectionError(
+                            f"cannot reach ps task {i} at {self.addresses[i]}"
+                        ) from None
+                    time.sleep(0.2)
+        return self._socks[i]
+
+    def call(self, i: int, msg: dict) -> dict:
+        with self._lock:
+            sock = self._sock(i)
+            _send_msg(sock, msg)
+            return _recv_msg(sock)
+
+    def wait_ready(self):
+        for i in range(len(self.addresses)):
+            self.call(i, {"op": "ping"})
+
+    def init_params(self, flat: dict[str, np.ndarray], assignment: dict[str, int]):
+        for i in range(len(self.addresses)):
+            shard = {k: v for k, v in flat.items() if assignment[k] == i}
+            self.call(i, {"op": "init_shard", "params": shard})
+
+    def wait_initialized(self, poll_s: float = 0.3):
+        """Non-chief behavior: wait for the chief's init (MNISTDist.py:170).
+        Polls EVERY ps task — the chief initializes them in order, so ps 0
+        answering ok does not imply the later shards are ready."""
+        for i in range(len(self.addresses)):
+            while True:
+                r = self.call(i, {"op": "pull"})
+                if r.get("ok"):
+                    break
+                time.sleep(poll_s)
+
+    def pull_all(self) -> tuple[dict[str, np.ndarray], int]:
+        flat: dict[str, np.ndarray] = {}
+        step = 0
+        for i in range(len(self.addresses)):
+            r = self.call(i, {"op": "pull"})
+            if not r.get("ok"):
+                raise RuntimeError(f"ps {i} not initialized")
+            flat.update(r["params"])
+            if i == 0:
+                step = r["global_step"]
+        return flat, step
+
+    def push_grads(self, flat_grads: dict[str, np.ndarray],
+                   assignment: dict[str, int], lr: float) -> int:
+        """Push each grad to its owning ps; ps 0 counts the global step."""
+        step = -1
+        for i in range(len(self.addresses)):
+            shard = {k: v for k, v in flat_grads.items() if assignment[k] == i}
+            r = self.call(i, {"op": "push_grads", "grads": shard, "lr": lr,
+                              "count_step": i == 0})
+            if i == 0:
+                step = r["global_step"]
+        return step
+
+    def get_step(self) -> int:
+        return self.call(0, {"op": "get_step"})["global_step"]
+
+    def shutdown_all(self):
+        for i in range(len(self.addresses)):
+            try:
+                self.call(i, {"op": "shutdown"})
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for s in self._socks:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._socks = [None] * len(self.addresses)
+
+
+# ---------------------------------------------------------------- roles
+
+def run_parameter_server(cluster, FLAGS):
+    """The ps role: bind, serve params, block forever
+    (MNISTDist.py:105-106)."""
+    addr = cluster.task_address("ps", FLAGS.task_index)
+    # bind on the port of our advertised address, all interfaces
+    port = addr.rsplit(":", 1)[1]
+    server = PSServer(FLAGS.task_index, f"0.0.0.0:{port}")
+    print(f"ps/{FLAGS.task_index} serving at {addr}")
+    server.serve_forever()
+
+
+def make_grad_fn(model, keep_prob: float):
+    """Jitted (params, batch, rng) -> (grads, metrics) — the worker-side
+    compute graph, XLA-compiled for the local TPU."""
+    from distributed_tensorflow_tpu.training.train_state import loss_and_metrics
+
+    @jax.jit
+    def grad_fn(params, batch, rng):
+        def loss_fn(p):
+            return loss_and_metrics(model, p, batch, keep_prob=keep_prob,
+                                    rng=rng, train=True)
+
+        return jax.grad(loss_fn, has_aux=True)(params)
+
+    return grad_fn
+
+
+def run_worker(cluster, FLAGS) -> int:
+    """The worker role: async stale-gradient SGD against the ps tasks —
+    the reference's hot loop (MNISTDist.py:172-188) with XLA compute."""
+    from distributed_tensorflow_tpu.data import read_data_sets
+    from distributed_tensorflow_tpu.training.loop import build_model_for
+    from distributed_tensorflow_tpu.training import make_eval_step
+    from distributed_tensorflow_tpu.training.train_state import evaluate
+    from distributed_tensorflow_tpu.utils import MetricsLogger
+
+    ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
+                        seed=FLAGS.seed + FLAGS.task_index)
+    model = build_model_for(FLAGS, ds.meta)
+    is_chief = FLAGS.task_index == 0
+
+    client = PSClient(cluster.ps_hosts)
+    client.wait_ready()
+
+    template = model.init(jax.random.PRNGKey(FLAGS.seed))
+    flat_template = flatten_params(template)
+    assignment = assign_shards(list(flat_template), cluster.num_tasks("ps"))
+
+    ckpt = Checkpointer(FLAGS.logdir, is_chief=is_chief,
+                        save_model_secs=FLAGS.save_model_secs)
+    if is_chief:
+        restored = ckpt.restore({"params": template, "step": 0})
+        if restored is not None:
+            blob, _ = restored
+            client.init_params(flatten_params(blob["params"]), assignment)
+            client.call(0, {"op": "set_step", "global_step": int(np.asarray(blob["step"]))})
+            print(f"worker/0 restored checkpoint at step {int(np.asarray(blob['step']))}")
+        else:
+            client.init_params(flat_template, assignment)
+    else:
+        client.wait_initialized()
+
+    grad_fn = make_grad_fn(model, FLAGS.keep_prob)
+    eval_fn = make_eval_step(model)
+    logger = MetricsLogger(FLAGS.logdir if is_chief else None,
+                           job_name="worker", task_index=FLAGS.task_index)
+    rng = jax.random.PRNGKey(FLAGS.seed * 7919 + FLAGS.task_index)
+
+    train_data = ds.train
+    if FLAGS.shard_data:
+        train_data = ds.train.shard(FLAGS.task_index, cluster.num_tasks("worker"))
+
+    step = client.get_step()
+    while step < FLAGS.training_iter:
+        batch = train_data.next_batch(FLAGS.batch_size)
+        flat, step = client.pull_all()
+        params = unflatten_params(template, flat)
+        if step % FLAGS.display_step == 0:
+            m = eval_fn(params, batch)
+            logger.log_display(step, float(m["loss"]), float(m["accuracy"]))
+        rng, sub = jax.random.split(rng)
+        grads, _ = grad_fn(params, batch, sub)
+        step = client.push_grads(flatten_params(grads), assignment,
+                                 FLAGS.learning_rate)
+        ckpt.maybe_save({"params": params, "step": step}, step)
+
+    if is_chief:
+        flat, step = client.pull_all()
+        params = unflatten_params(template, flat)
+        ckpt.save({"params": params, "step": step}, step)
+        if FLAGS.test_eval:
+            res = evaluate(model, params, ds.test)
+            print("test accuracy: ", res["accuracy"], "test loss: ", res["loss"])
+    print("Optimization Finished!")
+    logger.close()
+    return 0

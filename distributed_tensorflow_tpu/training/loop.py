@@ -1,0 +1,145 @@
+"""The training loop: reference hot-loop semantics on a TPU-native step.
+
+Reference loop (``MNISTDist.py:172-188``): while not stopped and
+``step < training_iter`` — draw a minibatch, every ``display_step`` print
+job/task + step + minibatch loss/accuracy (evaluated *before* the update,
+dropout off, ``:179-182``), then run one optimizer step. Termination is on
+the shared global step. On exit: ``sv.stop()`` + "Optimization Finished!"
+(``:192-193``).
+
+This loop keeps those semantics; what changed is underneath: the step is
+one compiled XLA executable with state resident in HBM, and display-step
+evaluation reuses a cached compiled eval fn. Modes:
+
+- "local": single device (CPU parity config / one TPU chip)
+- "sync":  synchronous DP over all local devices (mesh + psum over ICI)
+The async "ps" mode lives in parallel/ps_emulation.py and drives this
+same loop through a PS-backed step function.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+
+from distributed_tensorflow_tpu.data import read_data_sets
+from distributed_tensorflow_tpu.models import get_model
+from distributed_tensorflow_tpu.parallel import make_dp_train_step, make_mesh, shard_batch
+from distributed_tensorflow_tpu.parallel.data_parallel import (
+    make_dp_eval_step,
+    replicate_state,
+)
+from distributed_tensorflow_tpu.training import (
+    create_train_state,
+    get_optimizer,
+    make_eval_step,
+    make_train_step,
+)
+from distributed_tensorflow_tpu.training.supervisor import Supervisor
+from distributed_tensorflow_tpu.training.train_state import evaluate
+from distributed_tensorflow_tpu.utils import MetricsLogger, Throughput
+
+
+@dataclass
+class TrainResult:
+    final_step: int
+    train_metrics: dict[str, float]
+    test_metrics: dict[str, float] | None
+    images_per_sec: float
+    images_per_sec_per_chip: float
+    n_chips: int
+
+
+def build_model_for(FLAGS, meta: dict):
+    import jax.numpy as jnp
+
+    compute_dtype = jnp.bfloat16 if FLAGS.bf16 else None
+    if FLAGS.model == "deep_cnn":
+        return get_model(
+            "deep_cnn",
+            image_size=meta["image_size"],
+            channels=meta["channels"],
+            num_classes=meta["num_classes"],
+            compute_dtype=compute_dtype,
+        )
+    return get_model(
+        FLAGS.model,
+        num_classes=meta["num_classes"],
+        compute_dtype=compute_dtype,
+    )
+
+
+def train(FLAGS, mode: str = "local") -> TrainResult:
+    """Run a full training job in "local" or "sync" mode."""
+    ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
+                        seed=FLAGS.seed)
+    model = build_model_for(FLAGS, ds.meta)
+    opt = get_optimizer(FLAGS.optimizer, FLAGS.learning_rate)
+    state = create_train_state(model, opt, seed=FLAGS.seed)
+
+    n_chips = 1
+    if mode == "sync":
+        mesh = make_mesh()
+        n_chips = mesh.devices.size
+        if FLAGS.batch_size % n_chips:
+            raise ValueError(
+                f"--batch_size={FLAGS.batch_size} must be divisible by the "
+                f"{n_chips} devices in the data mesh"
+            )
+        state = replicate_state(mesh, state)
+        step_fn = make_dp_train_step(model, opt, mesh, keep_prob=FLAGS.keep_prob)
+        eval_fn = make_dp_eval_step(model, mesh)
+        prep = lambda b: shard_batch(mesh, b)
+    else:
+        step_fn = make_train_step(model, opt, keep_prob=FLAGS.keep_prob)
+        eval_fn = make_eval_step(model)
+        prep = lambda b: b
+
+    sv = Supervisor(
+        is_chief=(FLAGS.task_index == 0),
+        logdir=FLAGS.logdir,
+        save_model_secs=FLAGS.save_model_secs,
+    )
+    logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
+                           job_name=FLAGS.job_name or "worker",
+                           task_index=FLAGS.task_index)
+    meter = Throughput(FLAGS.batch_size, n_chips)
+    last_display = {}
+
+    with sv.managed(state) as box:
+        state, step = box.state, box.step
+        meter.reset()
+        while not sv.should_stop() and step < FLAGS.training_iter:
+            batch = prep(ds.train.next_batch(FLAGS.batch_size))
+            if step % FLAGS.display_step == 0:
+                m = eval_fn(state.params, batch)
+                last_display = {k: float(v) for k, v in m.items()}
+                logger.log_display(step, last_display["loss"],
+                                   last_display["accuracy"])
+                logger.scalars(step, {"images_per_sec": meter.images_per_sec})
+            state, _ = step_fn(state, batch)
+            step += 1
+            meter.step()
+            box.update(state, step)
+            sv.maybe_checkpoint(state, step)
+        jax.block_until_ready(state.params)
+
+    test_metrics = None
+    if FLAGS.test_eval:
+        test_metrics = evaluate(model, jax.device_get(state.params), ds.test)
+        print("test accuracy: ", test_metrics["accuracy"],
+              "test loss: ", test_metrics["loss"])
+        logger.scalars(step, {"test_accuracy": test_metrics["accuracy"],
+                              "test_loss": test_metrics["loss"]})
+    print("Optimization Finished!")
+    logger.close()
+    return TrainResult(
+        final_step=step,
+        train_metrics=last_display,
+        test_metrics=test_metrics,
+        images_per_sec=meter.images_per_sec,
+        images_per_sec_per_chip=meter.images_per_sec_per_chip,
+        n_chips=n_chips,
+    )

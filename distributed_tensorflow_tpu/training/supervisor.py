@@ -1,0 +1,82 @@
+"""Supervisor: the reference's training orchestration, re-built.
+
+``tf.train.Supervisor`` (``MNISTDist.py:158-170``) owns: chief designation
+(task 0), init-or-restore at session start, periodic chief-only
+checkpointing, a should_stop signal, and cleanup. This Supervisor owns the
+same responsibilities over a TrainState pytree; ``managed`` replaces
+``managed_session`` — it yields the (possibly restored) state and
+guarantees a final checkpoint + cleanup on the way out, including on error
+(the "closing when done or an error occurs" contract, MNISTDist.py:169-191).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from distributed_tensorflow_tpu.checkpoint import Checkpointer
+
+
+class Supervisor:
+    def __init__(
+        self,
+        is_chief: bool,
+        logdir: str,
+        save_model_secs: int = 600,
+        max_to_keep: int = 5,
+    ):
+        self.is_chief = is_chief
+        self.logdir = logdir
+        self.checkpointer = Checkpointer(
+            logdir, is_chief=is_chief, save_model_secs=save_model_secs,
+            max_to_keep=max_to_keep,
+        )
+        self._stop = False
+
+    def should_stop(self) -> bool:
+        return self._stop
+
+    def request_stop(self):
+        self._stop = True
+
+    def stop(self):
+        """MNISTDist.py:192 parity — idempotent shutdown signal."""
+        self._stop = True
+
+    def init_or_restore(self, init_state):
+        """Chief restores latest checkpoint or keeps the fresh init
+        (MNISTDist.py:169-170); returns (state, start_step)."""
+        restored = self.checkpointer.restore(init_state)
+        if restored is None:
+            return init_state, 0
+        state, step = restored
+        return state, step
+
+    def maybe_checkpoint(self, state, step: int):
+        return self.checkpointer.maybe_save(state, step)
+
+    @contextlib.contextmanager
+    def managed(self, init_state):
+        """Context manager over a training run: restore-or-init on entry,
+        final checkpoint + stop on exit (normal or error)."""
+        state_box = _StateBox(*self.init_or_restore(init_state))
+        try:
+            yield state_box
+        finally:
+            if state_box.state is not None and self.is_chief:
+                try:
+                    self.checkpointer.save(state_box.state, state_box.step)
+                except Exception as e:  # noqa: BLE001 — shutdown best-effort
+                    print(f"final checkpoint failed: {e}")
+            self.stop()
+
+
+class _StateBox:
+    """Mutable holder so the loop can publish progress to the supervisor."""
+
+    def __init__(self, state, step: int):
+        self.state = state
+        self.step = step
+
+    def update(self, state, step: int):
+        self.state = state
+        self.step = step

@@ -98,7 +98,9 @@ def apply_updates(params, updates):
 
 
 def create_train_state(model, optimizer: Optimizer, seed: int = 0) -> TrainState:
-    key = jax.random.key(seed)
+    # old-style raw uint32 keys: a plain array, so the whole TrainState
+    # (rng included) serializes through the numpy checkpoint path
+    key = jax.random.PRNGKey(seed)
     pkey, dkey = jax.random.split(key)
     params = model.init(pkey)
     return TrainState(
@@ -173,19 +175,20 @@ def make_eval_step(model):
     return eval_fn
 
 
-_EVAL_FN_CACHE: dict[int, Any] = {}
-
-
 def evaluate(model, params, dataset, batch_size: int = 1000, eval_fn=None) -> dict[str, float]:
     """Full-split evaluation (weighted over remainder batch).
 
-    The jitted eval fn is cached per model instance so repeated evaluation
-    (every ``display_step``) reuses the compiled executable instead of
-    retracing."""
+    The jitted eval fn is cached ON the model instance so repeated
+    evaluation (every ``display_step``) reuses the compiled executable
+    without a global registry pinning dead models."""
     if eval_fn is None:
-        eval_fn = _EVAL_FN_CACHE.get(id(model))
+        eval_fn = getattr(model, "_cached_eval_fn", None)
         if eval_fn is None:
-            eval_fn = _EVAL_FN_CACHE[id(model)] = make_eval_step(model)
+            eval_fn = make_eval_step(model)
+            try:
+                model._cached_eval_fn = eval_fn
+            except AttributeError:
+                pass  # exotic model object without attribute support
     n = dataset.num_examples
     images, labels = dataset.images, dataset.labels
     total = {"loss": 0.0, "accuracy": 0.0}

@@ -1,0 +1,4 @@
+from distributed_tensorflow_tpu.utils.metrics import MetricsLogger, reference_log_line
+from distributed_tensorflow_tpu.utils.profiling import StepTimer, Throughput
+
+__all__ = ["MetricsLogger", "reference_log_line", "StepTimer", "Throughput"]

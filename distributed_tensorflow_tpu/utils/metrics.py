@@ -1,0 +1,59 @@
+"""Metrics/observability: the reference's stdout format + scalar files.
+
+The reference's only observability is the cadenced print
+(``MNISTDist.py:183-186``) and a summary op that merges nothing
+(``:155`` — no summaries are ever defined, SURVEY.md §5). Here the same
+stdout line is reproduced verbatim-format, and every scalar also lands in
+a JSONL file any plotting tool can read — the working replacement for the
+event-file writer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def reference_log_line(job_name: str, task_index: int, step: int, loss, acc) -> str:
+    """The exact print of MNISTDist.py:183-186 (print-function comma
+    semantics: single-space join of the arguments)."""
+    return " ".join(
+        [
+            f"job: {job_name}/{task_index}",
+            "step: ",
+            str(step),
+            "mini_batch loss: ",
+            str(loss),
+            "training accuracy: ",
+            str(acc),
+        ]
+    )
+
+
+class MetricsLogger:
+    """Scalar logger: stdout (reference format) + JSONL scalars file."""
+
+    def __init__(self, logdir: str | None = None, job_name: str = "worker",
+                 task_index: int = 0, filename: str = "metrics.jsonl"):
+        self.job_name = job_name or "worker"
+        self.task_index = task_index
+        self._file = None
+        if logdir:
+            os.makedirs(logdir, exist_ok=True)
+            self._file = open(os.path.join(logdir, filename), "a", buffering=1)
+
+    def log_display(self, step: int, loss, acc):
+        print(reference_log_line(self.job_name, self.task_index, step, loss, acc))
+        self.scalars(step, {"mini_batch_loss": float(loss), "training_accuracy": float(acc)})
+
+    def scalars(self, step: int, values: dict):
+        if self._file is not None:
+            rec = {"step": int(step), "time": time.time(),
+                   "job": f"{self.job_name}/{self.task_index}", **values}
+            self._file.write(json.dumps(rec) + "\n")
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None

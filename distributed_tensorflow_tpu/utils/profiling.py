@@ -1,0 +1,72 @@
+"""Step timing / throughput meters + jax.profiler hooks.
+
+The reference has no tracing or profiling at all (``import time`` at
+MNISTDist.py:8 is dead — SURVEY.md §5). The build needs them for the
+BASELINE metric (images/sec/chip), so they are first-class here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+class StepTimer:
+    """Wall-clock per-step timer that excludes the first (compile) step."""
+
+    def __init__(self):
+        self.times: list[float] = []
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None:
+            self.times.append(time.perf_counter() - self._t0)
+            self._t0 = None
+
+    @property
+    def mean_step_s(self) -> float:
+        steady = self.times[1:] if len(self.times) > 1 else self.times
+        return sum(steady) / max(len(steady), 1)
+
+
+class Throughput:
+    """images/sec (and per-chip) meter over a training window."""
+
+    def __init__(self, batch_size: int, n_chips: int = 1):
+        self.batch_size = batch_size
+        self.n_chips = n_chips
+        self.reset()
+
+    def reset(self):
+        self._start = time.perf_counter()
+        self._images = 0
+
+    def step(self, n: int | None = None):
+        self._images += n if n is not None else self.batch_size
+
+    @property
+    def images_per_sec(self) -> float:
+        dt = time.perf_counter() - self._start
+        return self._images / dt if dt > 0 else 0.0
+
+    @property
+    def images_per_sec_per_chip(self) -> float:
+        return self.images_per_sec / max(self.n_chips, 1)
+
+
+@contextlib.contextmanager
+def trace(logdir: str | None):
+    """jax.profiler trace scope; no-op when logdir is falsy."""
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

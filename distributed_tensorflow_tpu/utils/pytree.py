@@ -1,0 +1,66 @@
+"""Shared pytree <-> path-keyed-dict conversion.
+
+One implementation used by both the checkpoint writer and the PS-emulation
+wire protocol, so the key scheme and dtype handling cannot drift between
+them. Keys are '/'-joined tree paths ("weights/wd1"); bfloat16 leaves are
+tagged and viewed as uint16 for serializers that can't store bf16 (npz).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+_BF16_TAG = "__bf16__"
+
+
+def _path_str(p) -> str:
+    for attr in ("key", "idx", "name"):
+        if hasattr(p, attr):
+            return str(getattr(p, attr))
+    return str(p)
+
+
+def path_key(path) -> str:
+    return "/".join(_path_str(p) for p in path)
+
+
+def flatten_pytree(tree, *, tag_bf16: bool = False) -> dict[str, np.ndarray]:
+    """Pytree -> {path_key: np.ndarray}. With ``tag_bf16``, bfloat16 leaves
+    are stored as uint16 views under a tagged key (npz-safe)."""
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = path_key(path)
+        arr = np.asarray(jax.device_get(leaf))
+        if tag_bf16 and arr.dtype == jax.numpy.bfloat16:
+            flat[_BF16_TAG + key] = arr.view(np.uint16)
+        else:
+            flat[key] = arr
+    return flat
+
+
+def unflatten_pytree(template, flat: dict[str, np.ndarray], *, check_shapes: bool = True):
+    """{path_key: array} -> pytree with ``template``'s structure.
+
+    Raises KeyError on missing keys and ValueError on shape mismatch (when
+    ``check_shapes``); casts to the template leaf dtype."""
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths_leaves:
+        key = path_key(path)
+        if key in flat:
+            arr = flat[key]
+        elif _BF16_TAG + key in flat:
+            arr = flat[_BF16_TAG + key].view(jax.numpy.bfloat16)
+        else:
+            raise KeyError(f"missing array for {key!r}")
+        leaf_arr = np.asarray(leaf)
+        if check_shapes and tuple(arr.shape) != tuple(leaf_arr.shape):
+            raise ValueError(
+                f"shape mismatch at {key!r}: got {arr.shape}, "
+                f"expected {leaf_arr.shape}"
+            )
+        if arr.dtype != leaf_arr.dtype:
+            arr = arr.astype(leaf_arr.dtype)
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,7 +1,12 @@
-"""Test env: force CPU with 8 virtual devices BEFORE jax initializes.
+"""Test env: force CPU with 8 virtual devices.
 
 This is the distributed-without-a-cluster strategy (SURVEY.md §4): mesh +
 collective code paths run on a simulated 8-device host, so CI needs no TPU.
+
+Note: env vars alone are NOT sufficient here — some environments import jax
+at interpreter boot (sitecustomize), after which JAX_PLATFORMS is already
+read. ``jax.config.update`` still works any time before backend
+initialization, so we use both.
 """
 
 import os
@@ -13,4 +18,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+assert len(jax.devices()) == 8, (
+    f"tests require the 8-device virtual CPU mesh, got {jax.devices()}"
+)

@@ -1,0 +1,111 @@
+"""Checkpoint/restore: atomicity, latest-selection, Supervisor semantics.
+
+Reference behavior under test: chief-only 600s-cadence checkpointing with
+auto-restore (MNISTDist.py:154,159-170).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.checkpoint import (
+    Checkpointer,
+    latest_checkpoint,
+    restore_latest,
+    save_checkpoint,
+)
+from distributed_tensorflow_tpu.models import DeepCNN
+from distributed_tensorflow_tpu.training import create_train_state, sgd
+from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+
+def _state():
+    return create_train_state(DeepCNN(), sgd(0.01), seed=0)
+
+
+def test_save_restore_roundtrip(tmp_path):
+    state = _state()
+    save_checkpoint(str(tmp_path), state, step=7)
+    restored, step = restore_latest(str(tmp_path), state)
+    assert step == 7
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_latest_picks_newest(tmp_path):
+    state = _state()
+    save_checkpoint(str(tmp_path), state, step=5)
+    save_checkpoint(str(tmp_path), state, step=12)
+    path, step = latest_checkpoint(str(tmp_path))
+    assert step == 12 and path.endswith("ckpt-12.npz")
+
+
+def test_gc_max_to_keep(tmp_path):
+    state = _state()
+    for s in range(8):
+        save_checkpoint(str(tmp_path), state, step=s, max_to_keep=3)
+    kept = sorted(f for f in os.listdir(tmp_path) if f.endswith(".npz"))
+    assert kept == ["ckpt-5.npz", "ckpt-6.npz", "ckpt-7.npz"]
+
+
+def test_restore_none_when_empty(tmp_path):
+    assert restore_latest(str(tmp_path / "nothing"), _state()) is None
+
+
+def test_torn_index_falls_back_to_files(tmp_path):
+    state = _state()
+    save_checkpoint(str(tmp_path), state, step=3)
+    with open(tmp_path / "checkpoint", "w") as f:
+        f.write("{corrupt")
+    path, step = latest_checkpoint(str(tmp_path))
+    assert step == 3
+
+
+def test_shape_mismatch_raises(tmp_path):
+    state = _state()
+    save_checkpoint(str(tmp_path), state, step=1)
+    other = create_train_state(DeepCNN(hidden_units=512), sgd(0.01), seed=0)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        restore_latest(str(tmp_path), other)
+
+
+def test_checkpointer_chief_only(tmp_path):
+    state = _state()
+    non_chief = Checkpointer(str(tmp_path), is_chief=False, save_model_secs=0)
+    assert non_chief.save(state, 1) is None
+    assert not os.listdir(tmp_path)
+
+
+def test_checkpointer_cadence(tmp_path):
+    state = _state()
+    ck = Checkpointer(str(tmp_path), is_chief=True, save_model_secs=10_000)
+    assert ck.maybe_save(state, 1) is None  # cadence not elapsed
+    ck._last_save = 0.0  # force elapsed
+    assert ck.maybe_save(state, 2) is not None
+
+
+def test_supervisor_managed_restores_and_final_saves(tmp_path):
+    state = _state()
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path), save_model_secs=10_000)
+    with sv.managed(state) as box:
+        assert box.step == 0
+        new_state = state._replace(step=state.step + 5)
+        box.update(new_state, 5)
+    assert sv.should_stop()
+    # a fresh supervisor restores step 5
+    sv2 = Supervisor(is_chief=True, logdir=str(tmp_path))
+    _, step = sv2.init_or_restore(state)
+    assert step == 5
+
+
+def test_supervisor_saves_on_error(tmp_path):
+    state = _state()
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path), save_model_secs=10_000)
+    with pytest.raises(RuntimeError):
+        with sv.managed(state) as box:
+            box.update(state, 3)
+            raise RuntimeError("worker died")
+    assert latest_checkpoint(str(tmp_path))[1] == 3

@@ -1,0 +1,80 @@
+"""Flag surface parity (reference MNISTDist.py:13-31) + flags module behavior."""
+
+import pytest
+
+from distributed_tensorflow_tpu import flags
+from distributed_tensorflow_tpu.cluster import ClusterSpec, resolve_mode
+
+
+@pytest.fixture(autouse=True)
+def fresh_flags():
+    flags.define_reference_flags()
+    flags.FLAGS._reset()
+    yield
+    flags.FLAGS._reset()
+
+
+def test_reference_flag_names_and_defaults():
+    flags.FLAGS._parse([])
+    F = flags.FLAGS
+    # the 10 reference flags with exact defaults (MNISTDist.py:14-31)
+    assert F.data_dir == "/tmp/mnist-data"
+    assert F.ps_hosts == ""
+    assert F.worker_hosts == ""
+    assert F.job_name == ""
+    assert F.task_index == 0
+    assert F.hidden_units == 100
+    assert F.batch_size == 128
+    assert F.training_iter == 10000
+    assert F.learning_rate == 0.001
+    assert F.display_step == 100
+
+
+def test_parse_equals_and_space_forms():
+    flags.FLAGS._parse([
+        "--job_name=worker", "--task_index", "2",
+        "--ps_hosts=h1:2222,h2:2222", "--learning_rate=0.01",
+    ])
+    F = flags.FLAGS
+    assert F.job_name == "worker"
+    assert F.task_index == 2
+    assert F.ps_hosts == "h1:2222,h2:2222"
+    assert F.learning_rate == 0.01
+
+
+def test_unknown_flag_attribute_raises():
+    flags.FLAGS._parse([])
+    with pytest.raises(AttributeError):
+        _ = flags.FLAGS.not_a_flag
+
+
+def test_bool_flag_forms():
+    flags.FLAGS._parse(["--bf16"])
+    assert flags.FLAGS.bf16 is True
+    flags.FLAGS._reset()
+    flags.FLAGS._parse(["--bf16=false"])
+    assert flags.FLAGS.bf16 is False
+
+
+def test_cluster_spec_from_flags():
+    flags.FLAGS._parse(["--ps_hosts=a:1,b:2", "--worker_hosts=c:3"])
+    cs = ClusterSpec.from_flags(flags.FLAGS)
+    assert cs.ps_hosts == ["a:1", "b:2"]
+    assert cs.worker_hosts == ["c:3"]
+    assert cs.task_address("ps", 1) == "b:2"
+    with pytest.raises(ValueError):
+        cs.task_address("worker", 5)
+
+
+def test_resolve_mode_auto():
+    flags.FLAGS._parse([])
+    assert resolve_mode(flags.FLAGS) == "local"
+    flags.FLAGS._reset()
+    flags.FLAGS._parse(["--ps_hosts=a:1", "--worker_hosts=b:2"])
+    assert resolve_mode(flags.FLAGS) == "ps"
+    flags.FLAGS._reset()
+    flags.FLAGS._parse(["--worker_hosts=b:2,c:3"])
+    assert resolve_mode(flags.FLAGS) == "sync"
+    flags.FLAGS._reset()
+    flags.FLAGS._parse(["--mode=local", "--ps_hosts=a:1"])
+    assert resolve_mode(flags.FLAGS) == "local"

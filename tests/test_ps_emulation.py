@@ -1,0 +1,150 @@
+"""Async PS emulation: sharding policy, protocol, stale-gradient semantics,
+multi-worker global-step termination — all in-process on localhost."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models import DeepCNN
+from distributed_tensorflow_tpu.parallel.ps_emulation import (
+    PSClient,
+    PSServer,
+    assign_shards,
+    flatten_params,
+    make_grad_fn,
+    unflatten_params,
+)
+
+
+@pytest.fixture()
+def ps_pair():
+    servers = [PSServer(i, "127.0.0.1:0") for i in range(2)]
+    for s in servers:
+        s.start_background()
+    client = PSClient([s.address for s in servers])
+    yield servers, client
+    client.close()
+    for s in servers:
+        s.close()
+
+
+def test_assign_shards_round_robin():
+    keys = ["b", "a", "d", "c"]
+    a = assign_shards(keys, 2)
+    # sorted order: a,b,c,d -> 0,1,0,1
+    assert a == {"a": 0, "b": 1, "c": 0, "d": 1}
+
+
+def test_flatten_unflatten_roundtrip():
+    model = DeepCNN()
+    params = model.init(jax.random.PRNGKey(0))
+    flat = flatten_params(params)
+    assert "weights/wd1" in flat
+    back = unflatten_params(params, flat)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pull_before_init_reports_uninitialized(ps_pair):
+    _, client = ps_pair
+    r = client.call(0, {"op": "pull"})
+    assert r == {"ok": False, "uninitialized": True}
+
+
+def test_init_pull_push_cycle(ps_pair):
+    _, client = ps_pair
+    flat = {"a": np.ones(4, np.float32), "b": np.full(3, 2.0, np.float32)}
+    assignment = assign_shards(list(flat), 2)
+    client.init_params(flat, assignment)
+    got, step = client.pull_all()
+    assert step == 0
+    np.testing.assert_allclose(got["a"], 1.0)
+    np.testing.assert_allclose(got["b"], 2.0)
+
+    # SGD on the ps: p -= lr*g, global step counted once on ps0
+    grads = {"a": np.ones(4, np.float32), "b": np.ones(3, np.float32)}
+    new_step = client.push_grads(grads, assignment, lr=0.5)
+    assert new_step == 1
+    got, _ = client.pull_all()
+    np.testing.assert_allclose(got["a"], 0.5)
+    np.testing.assert_allclose(got["b"], 1.5)
+
+
+def test_global_step_counts_total_pushes_across_workers(ps_pair):
+    """training_iter bounds TOTAL steps across workers (MNISTDist.py:173)."""
+    servers, client = ps_pair
+    flat = {"a": np.zeros(2, np.float32)}
+    assignment = assign_shards(list(flat), 2)
+    client.init_params(flat, assignment)
+
+    second = PSClient([s.address for s in servers])
+    try:
+        for _ in range(3):
+            client.push_grads({"a": np.ones(2, np.float32)}, assignment, lr=0.1)
+        for _ in range(2):
+            second.push_grads({"a": np.ones(2, np.float32)}, assignment, lr=0.1)
+        assert client.get_step() == 5
+    finally:
+        second.close()
+
+
+def test_concurrent_pushes_are_all_applied(ps_pair):
+    """Async semantics: racy but lossless — N pushes => N applied updates."""
+    servers, client = ps_pair
+    flat = {"a": np.zeros(1, np.float32)}
+    assignment = assign_shards(list(flat), 2)
+    client.init_params(flat, assignment)
+
+    n_workers, n_pushes = 4, 25
+    def worker():
+        c = PSClient([s.address for s in servers])
+        try:
+            for _ in range(n_pushes):
+                c.push_grads({"a": np.full(1, -1.0, np.float32)}, assignment, lr=1.0)
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    got, step = client.pull_all()
+    assert step == n_workers * n_pushes
+    np.testing.assert_allclose(got["a"], n_workers * n_pushes)  # -= 1.0 * -1.0 each
+
+
+def test_grad_fn_end_to_end_with_ps(ps_pair):
+    """A miniature async training loop drives the loss down."""
+    _, client = ps_pair
+    model = DeepCNN()
+    params = model.init(jax.random.PRNGKey(0))
+    flat = flatten_params(params)
+    assignment = assign_shards(list(flat), 2)
+    client.init_params(flat, assignment)
+
+    grad_fn = make_grad_fn(model, keep_prob=1.0)
+    from distributed_tensorflow_tpu.data.synthetic import synthetic_digits
+
+    xs, labels = synthetic_digits(16, seed=0)
+    x, y = jnp.asarray(xs), jax.nn.one_hot(jnp.asarray(labels), 10)
+
+    losses = []
+    rng = jax.random.PRNGKey(1)
+    for _ in range(10):
+        cur, _ = client.pull_all()
+        p = unflatten_params(params, cur)
+        rng, sub = jax.random.split(rng)
+        grads, metrics = grad_fn(p, (x, y), sub)
+        losses.append(float(metrics["loss"]))
+        client.push_grads(flatten_params(grads), assignment, lr=0.05)
+    assert min(losses[1:]) < losses[0], losses
+
+
+def test_shutdown_op(ps_pair):
+    servers, client = ps_pair
+    client.call(0, {"op": "shutdown"})
+    assert servers[0]._shutdown.is_set()

@@ -1,0 +1,46 @@
+"""Shared pytree flatten/unflatten: key scheme, bf16 tagging, validation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.utils.pytree import flatten_pytree, unflatten_pytree
+
+
+def test_roundtrip_nested():
+    tree = {"a": {"b": jnp.arange(4.0)}, "c": [jnp.ones(2), jnp.zeros(3)]}
+    flat = flatten_pytree(tree)
+    assert set(flat) == {"a/b", "c/0", "c/1"}
+    back = unflatten_pytree(tree, flat)
+    for x, y in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_bf16_tagging_roundtrip():
+    tree = {"w": jnp.ones(4, jnp.bfloat16)}
+    flat = flatten_pytree(tree, tag_bf16=True)
+    assert list(flat) == ["__bf16__w"]
+    assert flat["__bf16__w"].dtype == np.uint16
+    back = unflatten_pytree(tree, flat)
+    assert back["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(back["w"].astype(jnp.float32)), 1.0)
+
+
+def test_missing_key_raises():
+    tree = {"a": jnp.ones(2), "b": jnp.ones(2)}
+    flat = flatten_pytree({"a": jnp.ones(2)})
+    with pytest.raises(KeyError, match="'b'"):
+        unflatten_pytree(tree, flat)
+
+
+def test_shape_mismatch_raises():
+    tree = {"a": jnp.ones(2)}
+    with pytest.raises(ValueError, match="shape mismatch"):
+        unflatten_pytree(tree, {"a": np.ones(3, np.float32)})
+
+
+def test_dtype_cast_to_template():
+    tree = {"a": jnp.ones(2, jnp.float32)}
+    out = unflatten_pytree(tree, {"a": np.ones(2, np.float64)})
+    assert out["a"].dtype == np.float32
