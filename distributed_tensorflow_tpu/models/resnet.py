@@ -1,0 +1,194 @@
+"""ResNet for CIFAR — the deeper-conv-stack config (BASELINE.md config 4).
+
+Not in the reference (its only model is the MNIST CNN, MNISTDist.py:66-90);
+this is the "stresses XLA conv fusion" config from the driver's BASELINE.
+Classic CIFAR ResNet (He et al. 2015 §4.2): 3x3 stem, 3 stages of n basic
+blocks at widths 16/32/64, stride-2 at stage transitions, 1x1-projection
+shortcuts (option B), global average pool, dense head. depth = 6n+2 —
+n=3 gives ResNet-20.
+
+Stateful model protocol: ``init`` returns {"params", "state"} collections
+and ``apply(params, x, state=...)`` returns (logits, new_state) in train
+mode — the batch-norm running statistics live in the state collection and
+are EMA-updated by the forward pass, never by gradients.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.models.registry import register_model
+from distributed_tensorflow_tpu.ops import nn
+
+
+def _he_normal(key, shape, dtype=jnp.float32):
+    fan_in = 1
+    for d in shape[:-1]:
+        fan_in *= d
+    std = (2.0 / fan_in) ** 0.5
+    return std * jax.random.normal(key, shape, dtype)
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    return _he_normal(key, (kh, kw, cin, cout))
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+
+
+def _bn_state_init(c):
+    return {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))}
+
+
+@register_model("resnet")
+class ResNet:
+    """CIFAR ResNet-(6n+2). ``blocks_per_stage=3`` -> ResNet-20."""
+
+    stateful = True
+
+    def __init__(
+        self,
+        blocks_per_stage: int = 3,
+        widths: tuple = (16, 32, 64),
+        num_classes: int = 10,
+        channels: int = 3,
+        image_size: int = 32,
+        compute_dtype: Any = None,
+        bn_momentum: float = 0.9,
+    ):
+        self.n = blocks_per_stage
+        self.widths = tuple(widths)
+        self.num_classes = num_classes
+        self.channels = channels
+        self.image_size = image_size
+        self.compute_dtype = compute_dtype
+        self.bn_momentum = bn_momentum
+
+    # ------------------------------------------------------------ init
+
+    def init(self, key):
+        keys = iter(jax.random.split(key, 4 + 6 * self.n * len(self.widths)))
+        params: dict = {"stem": {"conv": _conv_init(next(keys), 3, 3, self.channels, self.widths[0]),
+                                 "bn": _bn_init(self.widths[0])}}
+        state: dict = {"stem": {"bn": _bn_state_init(self.widths[0])}}
+        cin = self.widths[0]
+        for s, width in enumerate(self.widths):
+            stage_p, stage_s = {}, {}
+            for b in range(self.n):
+                stride = 2 if (s > 0 and b == 0) else 1
+                block_p = {
+                    "conv1": _conv_init(next(keys), 3, 3, cin, width),
+                    "bn1": _bn_init(width),
+                    "conv2": _conv_init(next(keys), 3, 3, width, width),
+                    "bn2": _bn_init(width),
+                }
+                block_s = {"bn1": _bn_state_init(width), "bn2": _bn_state_init(width)}
+                if stride != 1 or cin != width:
+                    block_p["proj"] = _conv_init(next(keys), 1, 1, cin, width)
+                    block_p["proj_bn"] = _bn_init(width)
+                    block_s["proj_bn"] = _bn_state_init(width)
+                stage_p[f"block{b}"] = block_p
+                stage_s[f"block{b}"] = block_s
+                cin = width
+            params[f"stage{s}"] = stage_p
+            state[f"stage{s}"] = stage_s
+        params["head"] = {
+            "w": jnp.zeros((self.widths[-1], self.num_classes)),
+            "b": jnp.zeros((self.num_classes,)),
+        }
+        return {"params": params, "state": state}
+
+    # ----------------------------------------------------------- apply
+
+    def _conv(self, x, w, stride=1):
+        cd = self.compute_dtype
+        in_dtype = x.dtype
+        if cd is not None:
+            x, w = x.astype(cd), w.astype(cd)
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=(stride, stride), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return y.astype(in_dtype) if cd is not None else y
+
+    def _bn(self, x, p, s, train):
+        y, (m, v) = nn.batch_norm(
+            x, p["scale"], p["bias"], s["mean"], s["var"],
+            train=train, momentum=self.bn_momentum,
+        )
+        return y, {"mean": m, "var": v}
+
+    def apply(self, variables, x, *, keep_prob=1.0, rng=None, train: bool = False,
+              state=None):
+        """Forward pass. ``variables`` may be the full {"params","state"}
+        dict (then ``state`` is taken from it) or just the params collection
+        with ``state`` passed separately. Returns (logits, new_state) when
+        training, logits otherwise."""
+        if state is None and "state" in variables:
+            params, state = variables["params"], variables["state"]
+        elif "params" in variables:
+            params = variables["params"]
+        else:
+            params = variables
+        assert state is not None, "ResNet.apply needs the state collection"
+
+        new_state: dict = {"stem": {}, }
+        x = x.reshape(-1, self.image_size, self.image_size, self.channels)
+
+        h = self._conv(x, params["stem"]["conv"])
+        h, ns = self._bn(h, params["stem"]["bn"], state["stem"]["bn"], train)
+        new_state["stem"]["bn"] = ns
+        h = jax.nn.relu(h)
+
+        for s_i in range(len(self.widths)):
+            stage_p, stage_s = params[f"stage{s_i}"], state[f"stage{s_i}"]
+            new_stage: dict = {}
+            for b in range(self.n):
+                bp, bs = stage_p[f"block{b}"], stage_s[f"block{b}"]
+                stride = 2 if (s_i > 0 and b == 0) else 1
+                nbs: dict = {}
+
+                y = self._conv(h, bp["conv1"], stride)
+                y, nbs["bn1"] = self._bn(y, bp["bn1"], bs["bn1"], train)
+                y = jax.nn.relu(y)
+                y = self._conv(y, bp["conv2"])
+                y, nbs["bn2"] = self._bn(y, bp["bn2"], bs["bn2"], train)
+
+                if "proj" in bp:
+                    sc = self._conv(h, bp["proj"], stride)
+                    sc, nbs["proj_bn"] = self._bn(sc, bp["proj_bn"], bs["proj_bn"], train)
+                else:
+                    sc = h
+                h = jax.nn.relu(y + sc)
+                new_stage[f"block{b}"] = nbs
+            new_state[f"stage{s_i}"] = new_stage
+
+        h = jnp.mean(h, axis=(1, 2))  # global average pool
+        logits = nn.dense(h, params["head"]["w"], params["head"]["b"],
+                          compute_dtype=self.compute_dtype)
+        if train:
+            return logits, new_state
+        return logits
+
+    def num_params(self, variables=None):
+        if variables is None:
+            variables = jax.eval_shape(lambda: self.init(jax.random.PRNGKey(0)))
+        return sum(int(jnp.size(p)) for p in jax.tree.leaves(variables["params"]))
+
+
+@register_model("resnet20")
+class ResNet20(ResNet):
+    def __init__(self, **kw):
+        kw.setdefault("blocks_per_stage", 3)
+        super().__init__(**kw)
+
+
+@register_model("resnet32")
+class ResNet32(ResNet):
+    def __init__(self, **kw):
+        kw.setdefault("blocks_per_stage", 5)
+        super().__init__(**kw)

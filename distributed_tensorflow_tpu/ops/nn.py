@@ -34,6 +34,9 @@ def conv2d(x, w, b=None, strides: int = 1, *, compute_dtype=None):
     """
     in_dtype = x.dtype
     if compute_dtype is not None:
+        # uniform low-precision compute: the TPU MXU accumulates bf16
+        # matmul/conv products in f32 in hardware, and keeping operand and
+        # result dtypes equal keeps the conv VJP well-typed
         x = x.astype(compute_dtype)
         w = w.astype(compute_dtype)
     y = lax.conv_general_dilated(
@@ -42,12 +45,12 @@ def conv2d(x, w, b=None, strides: int = 1, *, compute_dtype=None):
         window_strides=(strides, strides),
         padding="SAME",
         dimension_numbers=_CONV_DIMS,
-        preferred_element_type=jnp.float32,
     )
+    if compute_dtype is not None:
+        y = y.astype(in_dtype)
     if b is not None:
         y = y + b.astype(y.dtype)
-    y = jax.nn.relu(y)
-    return y.astype(in_dtype) if compute_dtype is not None else y
+    return jax.nn.relu(y)
 
 
 def maxpool2d(x, k: int = 2):
@@ -63,13 +66,14 @@ def maxpool2d(x, k: int = 2):
 
 
 def dense(x, w, b=None, *, compute_dtype=None):
-    """x @ w + b (reference FC layers, MNISTDist.py:83,89). MXU matmul, f32 accumulate."""
+    """x @ w + b (reference FC layers, MNISTDist.py:83,89).
+
+    With ``compute_dtype`` the matmul runs in that dtype end-to-end
+    (operands and result), then casts back. On TPU the MXU still
+    accumulates bf16 products in f32 in hardware; other backends may
+    keep low-precision partial sums."""
     if compute_dtype is not None:
-        y = jnp.dot(
-            x.astype(compute_dtype),
-            w.astype(compute_dtype),
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        y = jnp.dot(x.astype(compute_dtype), w.astype(compute_dtype)).astype(x.dtype)
     else:
         y = jnp.dot(x, w)
     if b is not None:
@@ -113,3 +117,27 @@ def accuracy(logits, labels_onehot):
 @functools.partial(jax.jit, static_argnames=("num_classes",))
 def one_hot(labels, num_classes: int = 10):
     return jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+
+
+def batch_norm(x, scale, bias, running_mean, running_var, *,
+               train: bool, momentum: float = 0.9, eps: float = 1e-5):
+    """Batch normalization over NHWC (stats over N,H,W).
+
+    Returns (y, (new_running_mean, new_running_var)). In train mode the
+    batch statistics normalize and the running stats are EMA-updated; in
+    eval mode the running stats normalize and pass through unchanged.
+    Not in the reference (its CNN has no normalization); needed by the
+    ResNet-20/CIFAR-10 config (BASELINE.md config 4).
+    """
+    reduce_axes = tuple(range(x.ndim - 1))
+    if train:
+        mean = jnp.mean(x, axis=reduce_axes)
+        var = jnp.var(x, axis=reduce_axes)
+        new_mean = momentum * running_mean + (1.0 - momentum) * mean
+        new_var = momentum * running_var + (1.0 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x - mean) * inv * scale + bias
+    return y, (new_mean, new_var)

@@ -59,15 +59,21 @@ def make_dp_train_step(model, optimizer, mesh, keep_prob: float = 1.0, donate: b
 
         def loss_fn(params):
             return loss_and_metrics(
-                model, params, batch, keep_prob=keep_prob, rng=sub, train=True
+                model, params, batch, keep_prob=keep_prob, rng=sub, train=True,
+                model_state=state.model_state,
             )
 
-        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        grads, aux = jax.grad(loss_fn, has_aux=True)(state.params)
         grads = lax.pmean(grads, DATA_AXIS)
-        metrics = lax.pmean(metrics, DATA_AXIS)
+        metrics = lax.pmean(aux["metrics"], DATA_AXIS)
+        # cross-replica batch-norm stats: average the per-shard EMAs so the
+        # replicated state stays identical on every device
+        model_state = aux["model_state"]
+        if model_state:
+            model_state = lax.pmean(model_state, DATA_AXIS)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1, rng), metrics
+        return TrainState(params, opt_state, state.step + 1, rng, model_state), metrics
 
     state_spec = P()  # replicated
     batch_spec = (P(DATA_AXIS), P(DATA_AXIS))
@@ -86,15 +92,16 @@ def make_dp_train_step(model, optimizer, mesh, keep_prob: float = 1.0, donate: b
 def make_dp_eval_step(model, mesh):
     """Sharded full-batch eval: metrics pmean'd over the data axis."""
 
-    def per_shard(params, batch):
-        _, metrics = loss_and_metrics(model, params, batch, train=False)
-        return lax.pmean(metrics, DATA_AXIS)
+    def per_shard(params, batch, model_state):
+        _, aux = loss_and_metrics(model, params, batch, train=False,
+                                  model_state=model_state)
+        return lax.pmean(aux["metrics"], DATA_AXIS)
 
     return jax.jit(
         jax.shard_map(
             per_shard,
             mesh=mesh,
-            in_specs=(P(), (P(DATA_AXIS), P(DATA_AXIS))),
+            in_specs=(P(), (P(DATA_AXIS), P(DATA_AXIS)), P()),
             out_specs=P(),
             check_vma=False,
         )

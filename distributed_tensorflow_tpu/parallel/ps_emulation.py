@@ -296,13 +296,20 @@ def make_grad_fn(model, keep_prob: float):
     compute graph, XLA-compiled for the local TPU."""
     from distributed_tensorflow_tpu.training.train_state import loss_and_metrics
 
+    if getattr(model, "stateful", False):
+        raise NotImplementedError(
+            "ps-emulation mode supports stateless models (the reference's "
+            "deep CNN); stateful models (batch-norm ResNets) use sync mode"
+        )
+
     @jax.jit
     def grad_fn(params, batch, rng):
         def loss_fn(p):
             return loss_and_metrics(model, p, batch, keep_prob=keep_prob,
                                     rng=rng, train=True)
 
-        return jax.grad(loss_fn, has_aux=True)(params)
+        grads, aux = jax.grad(loss_fn, has_aux=True)(params)
+        return grads, aux["metrics"]
 
     return grad_fn
 

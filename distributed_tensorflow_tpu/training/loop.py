@@ -56,16 +56,10 @@ def build_model_for(FLAGS, meta: dict):
     import jax.numpy as jnp
 
     compute_dtype = jnp.bfloat16 if FLAGS.bf16 else None
-    if FLAGS.model == "deep_cnn":
-        return get_model(
-            "deep_cnn",
-            image_size=meta["image_size"],
-            channels=meta["channels"],
-            num_classes=meta["num_classes"],
-            compute_dtype=compute_dtype,
-        )
     return get_model(
         FLAGS.model,
+        image_size=meta["image_size"],
+        channels=meta["channels"],
         num_classes=meta["num_classes"],
         compute_dtype=compute_dtype,
     )
@@ -114,7 +108,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         while not sv.should_stop() and step < FLAGS.training_iter:
             batch = prep(ds.train.next_batch(FLAGS.batch_size))
             if step % FLAGS.display_step == 0:
-                m = eval_fn(state.params, batch)
+                m = eval_fn(state.params, batch, state.model_state)
                 last_display = {k: float(v) for k, v in m.items()}
                 logger.log_display(step, last_display["loss"],
                                    last_display["accuracy"])
@@ -128,7 +122,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
 
     test_metrics = None
     if FLAGS.test_eval:
-        test_metrics = evaluate(model, jax.device_get(state.params), ds.test)
+        test_metrics = evaluate(model, jax.device_get(state.params), ds.test,
+                                model_state=jax.device_get(state.model_state))
         print("test accuracy: ", test_metrics["accuracy"],
               "test loss: ", test_metrics["loss"])
         logger.scalars(step, {"test_accuracy": test_metrics["accuracy"],

@@ -25,12 +25,14 @@ from distributed_tensorflow_tpu.ops import nn
 
 
 class TrainState(NamedTuple):
-    """Pytree: params + optimizer slots + shared global step + dropout rng."""
+    """Pytree: params + optimizer slots + shared global step + dropout rng
+    + non-gradient model state (e.g. batch-norm running statistics)."""
 
     params: Any
     opt_state: Any
     step: jnp.ndarray  # scalar int32, the reference's global_step Variable
     rng: jnp.ndarray  # PRNG key threaded through dropout
+    model_state: Any = ()  # EMA stats etc; () for stateless models
 
 
 class Optimizer(NamedTuple):
@@ -102,21 +104,46 @@ def create_train_state(model, optimizer: Optimizer, seed: int = 0) -> TrainState
     # (rng included) serializes through the numpy checkpoint path
     key = jax.random.PRNGKey(seed)
     pkey, dkey = jax.random.split(key)
-    params = model.init(pkey)
+    variables = model.init(pkey)
+    if getattr(model, "stateful", False):
+        params, model_state = variables["params"], variables["state"]
+    else:
+        params, model_state = variables, ()
     return TrainState(
         params=params,
         opt_state=optimizer.init(params),
         step=jnp.zeros((), jnp.int32),
         rng=dkey,
+        model_state=model_state,
     )
 
 
-def loss_and_metrics(model, params, batch, *, keep_prob=1.0, rng=None, train=False):
+def loss_and_metrics(model, params, batch, *, keep_prob=1.0, rng=None,
+                     train=False, model_state=()):
+    """Returns (loss, aux) with aux = {"metrics": ..., "model_state": ...}.
+
+    For stateful models in train mode the forward pass also produces the
+    updated state collection (batch-norm EMAs); it rides through grad's
+    has_aux channel so the compiled step threads it into the next
+    TrainState without a second forward pass."""
     x, y = batch
-    logits = model.apply(params, x, keep_prob=keep_prob, rng=rng, train=train)
+    if getattr(model, "stateful", False):
+        if train:
+            logits, new_state = model.apply(
+                params, x, keep_prob=keep_prob, rng=rng, train=True,
+                state=model_state,
+            )
+        else:
+            logits = model.apply(params, x, keep_prob=keep_prob, rng=rng,
+                                 train=False, state=model_state)
+            new_state = model_state
+    else:
+        logits = model.apply(params, x, keep_prob=keep_prob, rng=rng, train=train)
+        new_state = model_state
     loss = nn.softmax_cross_entropy(logits, y)
     acc = nn.accuracy(logits, y)
-    return loss, {"loss": loss, "accuracy": acc}
+    return loss, {"metrics": {"loss": loss, "accuracy": acc},
+                  "model_state": new_state}
 
 
 def make_train_step(
@@ -142,10 +169,12 @@ def make_train_step(
 
         def loss_fn(params):
             return loss_and_metrics(
-                model, params, batch, keep_prob=keep_prob, rng=sub, train=True
+                model, params, batch, keep_prob=keep_prob, rng=sub, train=True,
+                model_state=state.model_state,
             )
 
-        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        grads, aux = jax.grad(loss_fn, has_aux=True)(state.params)
+        metrics, model_state = aux["metrics"], aux["model_state"]
         if grad_transform is not None:
             grads = grad_transform(grads)
         if metrics_transform is not None:
@@ -153,7 +182,7 @@ def make_train_step(
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = apply_updates(state.params, updates)
         return (
-            TrainState(params, opt_state, state.step + 1, rng),
+            TrainState(params, opt_state, state.step + 1, rng, model_state),
             metrics,
         )
 
@@ -163,19 +192,22 @@ def make_train_step(
 
 
 def make_eval_step(model):
-    """(params, batch) -> metrics, dropout off — the reference's eval run
-    (``MNISTDist.py:181-182``) but usable on the *test* set too (the
-    reference never evaluates on test data; the build's targets require it)."""
+    """(params, batch, model_state) -> metrics, dropout off — the
+    reference's eval run (``MNISTDist.py:181-182``) but usable on the *test*
+    set too (the reference never evaluates on test data; the build's
+    targets require it)."""
 
     @jax.jit
-    def eval_fn(params, batch):
-        _, metrics = loss_and_metrics(model, params, batch, train=False)
-        return metrics
+    def eval_fn(params, batch, model_state=()):
+        _, aux = loss_and_metrics(model, params, batch, train=False,
+                                  model_state=model_state)
+        return aux["metrics"]
 
     return eval_fn
 
 
-def evaluate(model, params, dataset, batch_size: int = 1000, eval_fn=None) -> dict[str, float]:
+def evaluate(model, params, dataset, batch_size: int = 1000, eval_fn=None,
+             model_state=()) -> dict[str, float]:
     """Full-split evaluation (weighted over remainder batch).
 
     The jitted eval fn is cached ON the model instance so repeated
@@ -195,7 +227,7 @@ def evaluate(model, params, dataset, batch_size: int = 1000, eval_fn=None) -> di
     seen = 0
     for i in range(0, n, batch_size):
         xs, ys = images[i : i + batch_size], labels[i : i + batch_size]
-        m = eval_fn(params, (xs, ys))
+        m = eval_fn(params, (xs, ys), model_state)
         w = len(xs)
         total = {k: total[k] + float(m[k]) * w for k in total}
         seen += w

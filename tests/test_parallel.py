@@ -114,7 +114,7 @@ def test_dp_eval_step(mesh):
     eval_fn = make_dp_eval_step(model, mesh)
     x = jax.random.normal(jax.random.key(3), (16, 784))
     y = jax.nn.one_hot(jnp.arange(16) % 10, 10)
-    m = eval_fn(state.params, shard_batch(mesh, (x, y)))
+    m = eval_fn(state.params, shard_batch(mesh, (x, y)), state.model_state)
     assert np.isfinite(float(m["loss"]))
 
 

@@ -1,0 +1,120 @@
+"""ResNet-20: shapes, param count, BN state semantics, train convergence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models import ResNet20, get_model
+from distributed_tensorflow_tpu.ops import nn
+from distributed_tensorflow_tpu.parallel import make_dp_train_step, make_mesh, shard_batch
+from distributed_tensorflow_tpu.parallel.data_parallel import replicate_state
+from distributed_tensorflow_tpu.training import adam, create_train_state, make_train_step
+from distributed_tensorflow_tpu.training.train_state import evaluate
+
+
+@pytest.fixture(scope="module")
+def model():
+    return ResNet20()
+
+
+@pytest.fixture(scope="module")
+def variables(model):
+    return model.init(jax.random.PRNGKey(0))
+
+
+def test_registry_names():
+    assert isinstance(get_model("resnet20"), ResNet20)
+    assert get_model("resnet32").n == 5
+
+
+def test_param_count(model, variables):
+    # classic CIFAR ResNet-20 is ~0.27M params
+    n = model.num_params(variables)
+    assert 260_000 < n < 290_000, n
+
+
+def test_forward_shapes(model, variables):
+    x = jnp.ones((4, 32, 32, 3))
+    logits = model.apply(variables, x)
+    assert logits.shape == (4, 10)
+
+
+def test_train_mode_returns_new_state(model, variables):
+    x = jax.random.normal(jax.random.key(0), (4, 32, 32, 3))
+    logits, new_state = model.apply(variables, x, train=True)
+    assert logits.shape == (4, 10)
+    # running stats moved away from init
+    m0 = np.asarray(variables["state"]["stem"]["bn"]["mean"])
+    m1 = np.asarray(new_state["stem"]["bn"]["mean"])
+    assert not np.allclose(m0, m1)
+
+
+def test_batch_norm_train_normalizes():
+    x = jax.random.normal(jax.random.key(1), (16, 8, 8, 4)) * 3 + 5
+    y, (nm, nv) = nn.batch_norm(
+        x, jnp.ones(4), jnp.zeros(4), jnp.zeros(4), jnp.ones(4), train=True
+    )
+    np.testing.assert_allclose(np.asarray(y.mean(axis=(0, 1, 2))), 0.0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(y.std(axis=(0, 1, 2))), 1.0, atol=1e-2)
+    # EMA moved toward batch stats
+    assert np.all(np.asarray(nm) > 0)
+
+
+def test_batch_norm_eval_uses_running_stats():
+    x = jnp.full((2, 2, 2, 1), 7.0)
+    y, (nm, nv) = nn.batch_norm(
+        x, jnp.ones(1), jnp.zeros(1), jnp.full(1, 7.0), jnp.ones(1), train=False
+    )
+    np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(nm), 7.0)
+
+
+def test_resnet_train_step_updates_state(model):
+    opt = adam(1e-3)
+    state = create_train_state(model, opt, seed=0)
+    assert state.model_state  # non-empty collection
+    step_fn = make_train_step(model, opt, donate=False)
+    x = jax.random.normal(jax.random.key(2), (8, 32, 32, 3))
+    y = jax.nn.one_hot(jnp.arange(8) % 10, 10)
+    new, metrics = step_fn(state, (x, y))
+    assert int(new.step) == 1
+    s0 = np.asarray(state.model_state["stem"]["bn"]["mean"])
+    s1 = np.asarray(new.model_state["stem"]["bn"]["mean"])
+    assert not np.allclose(s0, s1)
+
+
+def test_resnet_convergence_synthetic_cifar():
+    from distributed_tensorflow_tpu.data import read_data_sets
+
+    ds = read_data_sets("/nonexistent", one_hot=True, dataset="cifar10")
+    model = ResNet20()
+    opt = adam(1e-3)
+    state = create_train_state(model, opt, seed=0)
+    step_fn = make_train_step(model, opt)
+    first = None
+    for i in range(60):
+        batch = ds.train.next_batch(32)
+        state, m = step_fn(state, batch)
+        if first is None:
+            first = float(m["loss"])
+    assert float(m["loss"]) < first * 0.8
+    res = evaluate(model, state.params, ds.test, batch_size=500,
+                   model_state=state.model_state)
+    assert res["accuracy"] > 0.35
+
+
+def test_resnet_dp_step(model):
+    mesh = make_mesh()
+    opt = adam(1e-3)
+    state = replicate_state(mesh, create_train_state(model, opt, seed=0))
+    step_fn = make_dp_train_step(model, opt, mesh, donate=False)
+    x = jax.random.normal(jax.random.key(3), (16, 32, 32, 3))
+    y = jax.nn.one_hot(jnp.arange(16) % 10, 10)
+    state, metrics = step_fn(state, shard_batch(mesh, (x, y)))
+    assert np.isfinite(float(metrics["loss"]))
+    # BN state replicated identically across devices
+    mean = state.model_state["stem"]["bn"]["mean"]
+    shards = [np.asarray(s.data) for s in mean.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
