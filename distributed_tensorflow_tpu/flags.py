@@ -145,6 +145,8 @@ def define_reference_flags():
     DEFINE_integer("save_model_secs", 600, "Checkpoint cadence in seconds (reference default)")
     DEFINE_integer("seed", 0, "PRNG seed")
     DEFINE_boolean("bf16", False, "Run matmuls/convs in bfloat16 on the MXU")
+    DEFINE_boolean("pallas", False, "Use the fused Pallas kernel for the "
+                   "dominant FC layer (deep_cnn only)")
     DEFINE_boolean("test_eval", True, "Evaluate on the test split at the end "
                    "(the reference never does; targets require it)")
     DEFINE_boolean("shard_data", False, "Give each worker a disjoint data shard "

@@ -58,12 +58,14 @@ class DeepCNN:
         num_classes: int = 10,
         hidden_units: int = 1024,
         compute_dtype: Any = None,
+        use_pallas: bool = False,
     ):
         self.image_size = image_size
         self.channels = channels
         self.num_classes = num_classes
         self.hidden_units = hidden_units
         self.compute_dtype = compute_dtype
+        self.use_pallas = use_pallas
         # two 2x2 stride-2 SAME pools => ceil(size/4)
         self.pooled = math.ceil(math.ceil(image_size / 2) / 2)
         self.flat_dim = self.pooled * self.pooled * 64
@@ -103,7 +105,20 @@ class DeepCNN:
         x = nn.maxpool2d(x, k=2)
 
         x = x.reshape(-1, self.flat_dim)
-        x = jax.nn.relu(nn.dense(x, w["wd1"], b["bd1"], compute_dtype=cd))
+        if self.use_pallas:
+            # fused matmul+bias+relu Pallas kernel on the dominant FC layer
+            from distributed_tensorflow_tpu.ops import pallas_ops
+
+            interpret = jax.default_backend() == "cpu"
+            if cd is not None:
+                x = pallas_ops.fused_dense_relu(
+                    x.astype(cd), w["wd1"].astype(cd), b["bd1"].astype(cd),
+                    interpret,
+                ).astype(jnp.float32)
+            else:
+                x = pallas_ops.fused_dense_relu(x, w["wd1"], b["bd1"], interpret)
+        else:
+            x = jax.nn.relu(nn.dense(x, w["wd1"], b["bd1"], compute_dtype=cd))
         x = nn.dropout(x, keep_prob, rng, deterministic=not train)
         logits = nn.dense(x, w["out"], b["out"], compute_dtype=cd)
         return logits

@@ -1,0 +1,146 @@
+"""ctypes bindings for the native host data plane (fastdata.cpp).
+
+Builds the shared library on first import with g++ (cached next to the
+source); every entry point has a NumPy fallback in data/datasets.py, so a
+missing toolchain degrades gracefully — ``available()`` reports which path
+is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "fastdata.cpp")
+_SO = os.path.join(_DIR, "libfastdata.so")
+
+_lib = None
+_lock = threading.Lock()
+_build_error: str | None = None
+
+
+def _build() -> str | None:
+    """Compile fastdata.cpp -> libfastdata.so. Returns error string or None."""
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        _SRC, "-o", _SO,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"g++ unavailable: {e}"
+    if proc.returncode != 0:
+        return f"g++ failed: {proc.stderr[-500:]}"
+    return None
+
+
+def _load():
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            _build_error = _build()
+            if _build_error is not None:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            _build_error = f"dlopen failed: {e}"
+            return None
+        lib.idx_header.restype = ctypes.c_int
+        lib.idx_header.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.POINTER(ctypes.c_int64)]
+        lib.idx_read_u8.restype = ctypes.c_int64
+        lib.idx_read_u8.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_void_p, ctypes.c_int64]
+        lib.gather_normalize.restype = None
+        lib.gather_normalize.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_void_p, ctypes.c_int]
+        lib.onehot_gather.restype = None
+        lib.onehot_gather.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_int64, ctypes.c_int64,
+                                      ctypes.c_void_p]
+        lib.permutation.restype = None
+        lib.permutation.argtypes = [ctypes.c_int64, ctypes.c_uint64,
+                                    ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> str | None:
+    _load()
+    return _build_error
+
+
+def read_idx_u8(path: str) -> np.ndarray | None:
+    """Native IDX reader for uncompressed u8 files; None if inapplicable."""
+    lib = _load()
+    if lib is None or path.endswith(".gz"):
+        return None
+    ndim = ctypes.c_int()
+    dims = (ctypes.c_int64 * 8)()
+    off = ctypes.c_int64()
+    dtype = lib.idx_header(path.encode(), ctypes.byref(ndim), dims,
+                           ctypes.byref(off))
+    if dtype != 0x08:
+        return None
+    shape = tuple(dims[i] for i in range(ndim.value))
+    n = int(np.prod(shape)) if shape else 0
+    out = np.empty(n, np.uint8)
+    got = lib.idx_read_u8(path.encode(), off.value,
+                          out.ctypes.data_as(ctypes.c_void_p), n)
+    if got != n:
+        return None
+    return out.reshape(shape)
+
+
+def gather_normalize(images_u8: np.ndarray, idx: np.ndarray,
+                     threads: int = 4) -> np.ndarray | None:
+    """out[i] = images_u8[idx[i]] / 255 as float32; None if lib missing."""
+    lib = _load()
+    if lib is None:
+        return None
+    images_u8 = np.ascontiguousarray(images_u8)
+    idx = np.ascontiguousarray(idx, np.int64)
+    pixels = images_u8.shape[1]
+    out = np.empty((len(idx), pixels), np.float32)
+    lib.gather_normalize(images_u8.ctypes.data_as(ctypes.c_void_p),
+                         pixels, idx.ctypes.data_as(ctypes.c_void_p),
+                         len(idx), out.ctypes.data_as(ctypes.c_void_p),
+                         threads)
+    return out
+
+
+def onehot_gather(labels: np.ndarray, idx: np.ndarray, classes: int) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    labels = np.ascontiguousarray(labels, np.int64)
+    idx = np.ascontiguousarray(idx, np.int64)
+    out = np.zeros((len(idx), classes), np.float32)
+    lib.onehot_gather(labels.ctypes.data_as(ctypes.c_void_p),
+                      idx.ctypes.data_as(ctypes.c_void_p), len(idx), classes,
+                      out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def permutation(n: int, seed: int) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty(n, np.int64)
+    lib.permutation(n, seed & 0xFFFFFFFFFFFFFFFF,
+                    out.ctypes.data_as(ctypes.c_void_p))
+    return out

@@ -1,0 +1,101 @@
+// Native host-side data plane.
+//
+// The reference leans on TensorFlow's C++ runtime for everything host-side
+// (the tutorial DataSet feeding sess.run, MNISTDist.py:167,178-188). The
+// TPU rebuild keeps the device plane in XLA, and puts the host data plane
+// here: IDX decoding and batch assembly (gather + u8->f32 normalize +
+// one-hot) in C++, multithreaded, bound via ctypes (build: `make` in this
+// directory or the auto-build in __init__.py). A pure-NumPy fallback with
+// identical semantics lives in data/datasets.py.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Parse an IDX header. Returns the dtype code (0x08=u8 ...) or -1 on error.
+// Writes ndim and up to 8 dims. The payload starts at *payload_off.
+int idx_header(const char* path, int* ndim, int64_t* dims, int64_t* payload_off) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char magic[4];
+    if (std::fread(magic, 1, 4, f) != 4 || magic[0] != 0 || magic[1] != 0) {
+        std::fclose(f);
+        return -1;
+    }
+    int dtype = magic[2];
+    int nd = magic[3];
+    if (nd > 8) { std::fclose(f); return -1; }
+    *ndim = nd;
+    for (int i = 0; i < nd; i++) {
+        unsigned char b[4];
+        if (std::fread(b, 1, 4, f) != 4) { std::fclose(f); return -1; }
+        dims[i] = (int64_t(b[0]) << 24) | (int64_t(b[1]) << 16) |
+                  (int64_t(b[2]) << 8) | int64_t(b[3]);
+    }
+    *payload_off = 4 + 4 * nd;
+    std::fclose(f);
+    return dtype;
+}
+
+// Read n bytes of u8 payload at offset into out. Returns bytes read.
+int64_t idx_read_u8(const char* path, int64_t offset, uint8_t* out, int64_t n) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    if (std::fseek(f, long(offset), SEEK_SET) != 0) { std::fclose(f); return -1; }
+    int64_t got = int64_t(std::fread(out, 1, size_t(n), f));
+    std::fclose(f);
+    return got;
+}
+
+// Batch assembly: out[i,:] = images[idx[i],:] / 255.0f, multithreaded.
+void gather_normalize(const uint8_t* images, int64_t pixels,
+                      const int64_t* idx, int64_t batch, float* out,
+                      int threads) {
+    if (threads < 1) threads = 1;
+    auto work = [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; i++) {
+            const uint8_t* src = images + idx[i] * pixels;
+            float* dst = out + i * pixels;
+            for (int64_t p = 0; p < pixels; p++) dst[p] = float(src[p]) * (1.0f / 255.0f);
+        }
+    };
+    if (threads == 1 || batch < 64) {
+        work(0, batch);
+        return;
+    }
+    std::vector<std::thread> pool;
+    int64_t chunk = (batch + threads - 1) / threads;
+    for (int t = 0; t < threads; t++) {
+        int64_t lo = t * chunk, hi = lo + chunk < batch ? lo + chunk : batch;
+        if (lo >= hi) break;
+        pool.emplace_back(work, lo, hi);
+    }
+    for (auto& th : pool) th.join();
+}
+
+// One-hot: out[i, labels[idx[i]]] = 1.0f (out must be zeroed by caller).
+void onehot_gather(const int64_t* labels, const int64_t* idx, int64_t batch,
+                   int64_t classes, float* out) {
+    for (int64_t i = 0; i < batch; i++) {
+        int64_t c = labels[idx[i]];
+        if (c >= 0 && c < classes) out[i * classes + c] = 1.0f;
+    }
+}
+
+// Fisher-Yates permutation with xorshift64*, for epoch shuffles.
+void permutation(int64_t n, uint64_t seed, int64_t* out) {
+    for (int64_t i = 0; i < n; i++) out[i] = i;
+    uint64_t s = seed ? seed : 0x9E3779B97F4A7C15ull;
+    for (int64_t i = n - 1; i > 0; i--) {
+        s ^= s >> 12; s ^= s << 25; s ^= s >> 27;
+        uint64_t r = s * 0x2545F4914F6CDD1Dull;
+        int64_t j = int64_t(r % uint64_t(i + 1));
+        int64_t tmp = out[i]; out[i] = out[j]; out[j] = tmp;
+    }
+}
+
+}  // extern "C"

@@ -56,12 +56,16 @@ def build_model_for(FLAGS, meta: dict):
     import jax.numpy as jnp
 
     compute_dtype = jnp.bfloat16 if FLAGS.bf16 else None
+    kwargs = {}
+    if FLAGS.model == "deep_cnn" and getattr(FLAGS, "pallas", False):
+        kwargs["use_pallas"] = True
     return get_model(
         FLAGS.model,
         image_size=meta["image_size"],
         channels=meta["channels"],
         num_classes=meta["num_classes"],
         compute_dtype=compute_dtype,
+        **kwargs,
     )
 
 
