@@ -221,6 +221,11 @@ def read_data_sets(
 
     val = None
     if validation_size:
+        if not 0 <= validation_size < len(trx):
+            raise ValueError(
+                f"validation_size={validation_size} must be in "
+                f"[0, {len(trx)}) for this train split"
+            )
         val = DataSet(trx[:validation_size], trl[:validation_size],
                       one_hot=one_hot, seed=seed + 2)
         trx, trl = trx[validation_size:], trl[validation_size:]

@@ -25,12 +25,15 @@ def batch_iterator(dataset, batch_size: int) -> Iterator:
 _END = object()
 
 
-def prefetch_to_device(it: Iterator, size: int = 2, sharding=None) -> Iterator:
+def prefetch_to_device(
+    it: Iterator, size: int = 2, sharding=None, stage: Callable | None = None
+) -> Iterator:
     """Wrap a host batch iterator with a device-prefetch queue of ``size``.
 
     With ``sharding`` (a jax.sharding.Sharding), batches land on the mesh
     pre-sharded (e.g. split on the 'data' axis) so the jitted step never
-    reshuffles input layout.
+    reshuffles input layout. ``stage`` overrides the placement entirely
+    (e.g. ``shard_batch`` for multi-process global-array assembly).
 
     Worker exceptions propagate to the consumer (no silent end-of-stream),
     and closing the generator (break / .close()) unblocks and terminates
@@ -39,10 +42,11 @@ def prefetch_to_device(it: Iterator, size: int = 2, sharding=None) -> Iterator:
     q: queue.Queue = queue.Queue(maxsize=size)
     stop = threading.Event()
 
-    def _stage(batch):
-        if sharding is not None:
-            return jax.device_put(batch, sharding)
-        return jax.device_put(batch)
+    if stage is None:
+        def stage(batch):
+            if sharding is not None:
+                return jax.device_put(batch, sharding)
+            return jax.device_put(batch)
 
     def _send(item) -> bool:
         """put that gives up when the consumer has stopped."""
@@ -57,7 +61,7 @@ def prefetch_to_device(it: Iterator, size: int = 2, sharding=None) -> Iterator:
     def _worker():
         try:
             for batch in it:
-                if stop.is_set() or not _send(_stage(batch)):
+                if stop.is_set() or not _send(stage(batch)):
                     return
             _send(_END)
         except BaseException as e:  # noqa: BLE001 — delivered to the consumer

@@ -151,3 +151,8 @@ def define_reference_flags():
                    "(the reference never does; targets require it)")
     DEFINE_boolean("shard_data", False, "Give each worker a disjoint data shard "
                    "(reference: every worker samples the full dataset)")
+    DEFINE_string("profile_dir", "", "If set, capture a jax.profiler trace of "
+                  "--profile_steps post-compile training steps into this dir")
+    DEFINE_integer("profile_steps", 10, "Number of steps in the profiler window")
+    DEFINE_integer("validation_size", 0, "Examples held out of the train split "
+                   "as a validation DataSet (0 = none, reference behavior)")

@@ -33,13 +33,44 @@ from distributed_tensorflow_tpu.training.train_state import (
 
 
 def shard_batch(mesh, batch):
-    """Lay a host batch out across the mesh's data axis (device_put with a
-    NamedSharding — the input-side half of DP)."""
+    """Lay a host batch out across the mesh's data axis.
+
+    Single-process: one device_put of the full global batch with a
+    NamedSharding (the input-side half of DP). Multi-process (multi-host
+    SPMD, the reference's one-process-per-machine topology,
+    ``MNISTDist.py:101-103``): ``batch`` is this process's LOCAL slice of
+    the global batch; the slices are assembled into one global-mesh array
+    via ``jax.make_array_from_process_local_data`` — each host uploads only
+    to its own chips, no cross-host data movement.
+    """
     x, y = batch
+    if jax.process_count() > 1:
+        import numpy as np
+
+        return (
+            jax.make_array_from_process_local_data(
+                batch_sharding(mesh, x.ndim), np.asarray(x)
+            ),
+            jax.make_array_from_process_local_data(
+                batch_sharding(mesh, y.ndim), np.asarray(y)
+            ),
+        )
     return (
         jax.device_put(x, batch_sharding(mesh, x.ndim)),
         jax.device_put(y, batch_sharding(mesh, y.ndim)),
     )
+
+
+def local_batch_size(global_batch_size: int) -> int:
+    """This process's share of the global batch (multi-host sync DP feeds
+    each host ``global/process_count`` examples per step)."""
+    n = jax.process_count()
+    if global_batch_size % n:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"{n} processes"
+        )
+    return global_batch_size // n
 
 
 def make_dp_train_step(model, optimizer, mesh, keep_prob: float = 1.0, donate: bool = True):

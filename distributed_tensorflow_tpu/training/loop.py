@@ -25,9 +25,11 @@ from typing import Any
 import jax
 
 from distributed_tensorflow_tpu.data import read_data_sets
+from distributed_tensorflow_tpu.data.pipeline import batch_iterator, prefetch_to_device
 from distributed_tensorflow_tpu.models import get_model
 from distributed_tensorflow_tpu.parallel import make_dp_train_step, make_mesh, shard_batch
 from distributed_tensorflow_tpu.parallel.data_parallel import (
+    local_batch_size,
     make_dp_eval_step,
     replicate_state,
 )
@@ -70,14 +72,26 @@ def build_model_for(FLAGS, meta: dict):
 
 
 def train(FLAGS, mode: str = "local") -> TrainResult:
-    """Run a full training job in "local" or "sync" mode."""
+    """Run a full training job in "local" or "sync" mode.
+
+    "sync" spans every device in the process's view: all local chips on one
+    host, or the global multi-host mesh when ``jax.distributed`` was
+    initialized first (the reference's one-process-per-machine topology,
+    ``MNISTDist.py:101-107``). In the multi-host case each process feeds
+    its own slice of the global batch (assembled in ``shard_batch``) and
+    draws from an independently-seeded shuffle, matching the reference's
+    per-worker input semantics (``MNISTDist.py:167,178``).
+    """
+    n_procs = jax.process_count()
+    data_seed = FLAGS.seed + (jax.process_index() if n_procs > 1 else 0)
     ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
-                        seed=FLAGS.seed)
+                        seed=data_seed, validation_size=FLAGS.validation_size)
     model = build_model_for(FLAGS, ds.meta)
     opt = get_optimizer(FLAGS.optimizer, FLAGS.learning_rate)
     state = create_train_state(model, opt, seed=FLAGS.seed)
 
     n_chips = 1
+    feed_batch = FLAGS.batch_size  # examples this process loads per step
     if mode == "sync":
         mesh = make_mesh()
         n_chips = mesh.devices.size
@@ -86,14 +100,15 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 f"--batch_size={FLAGS.batch_size} must be divisible by the "
                 f"{n_chips} devices in the data mesh"
             )
+        feed_batch = local_batch_size(FLAGS.batch_size)
         state = replicate_state(mesh, state)
         step_fn = make_dp_train_step(model, opt, mesh, keep_prob=FLAGS.keep_prob)
         eval_fn = make_dp_eval_step(model, mesh)
-        prep = lambda b: shard_batch(mesh, b)
+        stage = lambda b: shard_batch(mesh, b)
     else:
         step_fn = make_train_step(model, opt, keep_prob=FLAGS.keep_prob)
         eval_fn = make_eval_step(model)
-        prep = lambda b: b
+        stage = None  # prefetch default: device_put to the default device
 
     sv = Supervisor(
         is_chief=(FLAGS.task_index == 0),
@@ -106,23 +121,67 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     meter = Throughput(FLAGS.batch_size, n_chips)
     last_display = {}
 
+    should_stop = sv.should_stop
+    if mode == "sync" and n_procs > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        def should_stop():
+            # a stop (SIGTERM on one host, say) must take effect at the SAME
+            # step on every process — a process leaving the loop alone would
+            # deadlock the rest inside the next collective. One tiny
+            # allgather per step buys that agreement.
+            votes = multihost_utils.process_allgather(
+                np.int32(sv.should_stop())
+            )
+            return bool(votes.max())
+
     with sv.managed(state) as box:
         state, step = box.state, box.step
-        meter.reset()
-        while not sv.should_stop() and step < FLAGS.training_iter:
-            batch = prep(ds.train.next_batch(FLAGS.batch_size))
-            if step % FLAGS.display_step == 0:
-                m = eval_fn(state.params, batch, state.model_state)
-                last_display = {k: float(v) for k, v in m.items()}
-                logger.log_display(step, last_display["loss"],
-                                   last_display["accuracy"])
-                logger.scalars(step, {"images_per_sec": meter.images_per_sec})
-            state, _ = step_fn(state, batch)
-            step += 1
-            meter.step()
-            box.update(state, step)
-            sv.maybe_checkpoint(state, step)
-        jax.block_until_ready(state.params)
+        # background host->device staging; the accelerator never waits on
+        # next_batch (the feed-dict bottleneck this build eliminates,
+        # SURVEY.md §3.4)
+        batches = prefetch_to_device(
+            batch_iterator(ds.train, feed_batch), size=2, stage=stage
+        )
+        profiling = False
+        profile_done = not FLAGS.profile_dir
+        compile_done = False
+        try:
+            meter.reset()
+            while not should_stop() and step < FLAGS.training_iter:
+                batch = next(batches)
+                if step % FLAGS.display_step == 0:
+                    m = eval_fn(state.params, batch, state.model_state)
+                    last_display = {k: float(v) for k, v in m.items()}
+                    logger.log_display(step, last_display["loss"],
+                                       last_display["accuracy"])
+                    logger.scalars(step, {"images_per_sec": meter.images_per_sec})
+                if compile_done and not profile_done and not profiling:
+                    jax.profiler.start_trace(FLAGS.profile_dir)
+                    profiling = True
+                    profile_stop_at = step + FLAGS.profile_steps
+                state, _ = step_fn(state, batch)
+                step += 1
+                meter.step()
+                if not compile_done:
+                    # first step carries XLA compile; keep it out of the
+                    # throughput window
+                    jax.block_until_ready(state.params)
+                    meter.reset()
+                    compile_done = True
+                if profiling and step >= profile_stop_at:
+                    jax.block_until_ready(state.params)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    profile_done = True
+                box.update(state, step)
+                sv.maybe_checkpoint(state, step)
+            jax.block_until_ready(state.params)
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
+            batches.close()
 
     test_metrics = None
     if FLAGS.test_eval:

@@ -12,6 +12,7 @@ guarantees a final checkpoint + cleanup on the way out, including on error
 from __future__ import annotations
 
 import contextlib
+import signal
 
 from distributed_tensorflow_tpu.checkpoint import Checkpointer
 
@@ -54,14 +55,50 @@ class Supervisor:
     def maybe_checkpoint(self, state, step: int):
         return self.checkpointer.maybe_save(state, step)
 
+    def _install_signal_handlers(self):
+        """SIGTERM/SIGINT -> request_stop, so the loop exits cleanly and
+        ``managed`` writes the final checkpoint (the Supervisor recovery
+        contract, MNISTDist.py:169-191: close cleanly 'when done or an
+        error occurs'). Returns a restore callable; no-op off the main
+        thread (signal.signal is main-thread-only)."""
+        previous = {}
+
+        def _handler(signum, frame):
+            print(f"signal {signum}: stop requested, checkpointing... "
+                  f"(repeat to force-quit)", flush=True)
+            self.request_stop()
+            # escalation path: restore the original dispositions so a
+            # second signal (e.g. repeated Ctrl-C on a wedged run) is not
+            # swallowed by this handler
+            for sig, old in previous.items():
+                signal.signal(sig, old)
+
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                previous[sig] = signal.signal(sig, _handler)
+        except ValueError:  # not the main thread
+            previous = {}
+
+        def _restore():
+            for sig, old in previous.items():
+                signal.signal(sig, old)
+
+        return _restore
+
     @contextlib.contextmanager
-    def managed(self, init_state):
+    def managed(self, init_state, handle_signals: bool = True):
         """Context manager over a training run: restore-or-init on entry,
-        final checkpoint + stop on exit (normal or error)."""
+        final checkpoint + stop on exit (normal, error, or SIGTERM/SIGINT
+        — the signal path requests a stop, the loop drains, and the final
+        save lands here)."""
         state_box = _StateBox(*self.init_or_restore(init_state))
+        restore_signals = (
+            self._install_signal_handlers() if handle_signals else lambda: None
+        )
         try:
             yield state_box
         finally:
+            restore_signals()
             if state_box.state is not None and self.is_chief:
                 try:
                     self.checkpointer.save(state_box.state, state_box.step)

@@ -1,0 +1,138 @@
+"""Worker subprocess for the multi-host sync-DP test (not a pytest file).
+
+Each invocation is one "host": a process owning 4 virtual CPU devices that
+joins a 2-process jax.distributed cluster over localhost, builds the global
+8-device mesh, feeds its own slice of every global batch, trains 5 sync-DP
+steps, and dumps its final params. The pytest side asserts params are
+identical across processes and equal to a single-process 8-device run —
+the determinism property the reference's async mode gives up and this
+build's sync mode guarantees (SURVEY.md §2c).
+
+Usage: python multihost_worker.py <step|train> <process_id> <num_processes> <port> <outdir>
+
+"step"  — hand-rolled 5-step run on deterministic batches (params dumped
+          for cross-process / vs-single-process comparison)
+"train" — the PRODUCTION loop: training.loop.train(mode="sync") end to end
+          (prefetch pipeline, supervisor, per-process dataset seeds, the
+          cross-process stop-vote), asserting it completes.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+GLOBAL_BATCH = 16
+STEPS = 5
+LR = 0.05
+
+
+def make_batch(i: int, n: int):
+    """Deterministic global batch i — identical on every process."""
+    rng = np.random.default_rng(1000 + i)
+    x = rng.random((n, 784), np.float32)
+    y = np.zeros((n, 10), np.float32)
+    y[np.arange(n), rng.integers(0, 10, n)] = 1.0
+    return x, y
+
+
+def _init_cluster(process_id: int, num_processes: int, port: str):
+    # virtual 4-device CPU platform BEFORE backend init (conftest recipe:
+    # config-update beats a sitecustomize JAX_PLATFORMS pin, env alone loses)
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.process_count() == num_processes
+    assert jax.local_device_count() == 4
+    assert jax.device_count() == 4 * num_processes
+    return jax
+
+
+def run_train_loop(process_id: int, num_processes: int, port: str, outdir: str) -> None:
+    """Production path: flags + train(mode="sync") across 2 processes."""
+    jax = _init_cluster(process_id, num_processes, port)
+
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    flags.FLAGS._parse([
+        f"--logdir={outdir}/logs",
+        f"--data_dir={outdir}/no-data",  # forces synthetic
+        "--training_iter=12",
+        "--batch_size=32",
+        "--display_step=4",
+        "--optimizer=adam",
+        "--learning_rate=0.002",
+        "--save_model_secs=100000",
+        f"--task_index={process_id}",
+    ])
+    res = train(flags.FLAGS, mode="sync")
+    assert res.final_step == 12, res
+    assert res.n_chips == 4 * num_processes, res
+    print(f"TRAIN_OK p{process_id} step={res.final_step}", flush=True)
+    jax.distributed.shutdown()
+
+
+def run(process_id: int, num_processes: int, port: str, outdir: str) -> None:
+    jax = _init_cluster(process_id, num_processes, port)
+
+    from distributed_tensorflow_tpu.models import DeepCNN
+    from distributed_tensorflow_tpu.parallel import (
+        MeshSpec,
+        make_dp_train_step,
+        make_mesh,
+        shard_batch,
+    )
+    from distributed_tensorflow_tpu.parallel.data_parallel import (
+        local_batch_size,
+        replicate_state,
+    )
+    from distributed_tensorflow_tpu.training import create_train_state, sgd
+
+    mesh = make_mesh(MeshSpec(data=jax.device_count(), model=1))
+    model = DeepCNN()
+    opt = sgd(LR)
+    state = replicate_state(mesh, create_train_state(model, opt, seed=0))
+    step_fn = make_dp_train_step(model, opt, mesh, keep_prob=1.0, donate=False)
+
+    local = local_batch_size(GLOBAL_BATCH)
+    lo = process_id * local
+    snapshots = {}
+    for i in range(STEPS):
+        x, y = make_batch(i, GLOBAL_BATCH)
+        # this process's slice only — shard_batch assembles the global array
+        batch = shard_batch(mesh, (x[lo : lo + local], y[lo : lo + local]))
+        state, metrics = step_fn(state, batch)
+        if i == 0:
+            # step-1 snapshot: compared tightly against the single-process
+            # run (before chaotic float divergence can amplify the
+            # all-reduce's different reduction order)
+            leaves, _ = jax.tree_util.tree_flatten(jax.device_get(state.params))
+            snapshots.update(
+                {f"step1_leaf_{j}": np.asarray(l) for j, l in enumerate(leaves)}
+            )
+    jax.block_until_ready(state.params)
+    assert int(state.step) == STEPS
+
+    leaves, _ = jax.tree_util.tree_flatten(jax.device_get(state.params))
+    snapshots.update({f"leaf_{j}": np.asarray(l) for j, l in enumerate(leaves)})
+    np.savez(
+        os.path.join(outdir, f"params_p{process_id}.npz"),
+        **snapshots,
+        loss=np.float32(metrics["loss"]),
+    )
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1]
+    fn = {"step": run, "train": run_train_loop}[mode]
+    fn(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5])
