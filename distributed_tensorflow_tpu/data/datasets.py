@@ -81,10 +81,10 @@ class DataSet:
             return out
         return self.labels_int
 
-    def next_batch(self, batch_size: int) -> tuple[np.ndarray, np.ndarray]:
+    def _next_indices(self, batch_size: int) -> np.ndarray:
         """Sequential walk over a shuffled order, reshuffling each epoch —
-        the tutorial ``DataSet.next_batch`` behavior the reference's hot loop
-        calls (``MNISTDist.py:178``)."""
+        the tutorial ``DataSet.next_batch`` index behavior the reference's
+        hot loop relies on (``MNISTDist.py:178``)."""
         if self.num_examples == 0:
             raise ValueError("next_batch on an empty DataSet (0 examples)")
         idx = np.empty(batch_size, dtype=np.int64)
@@ -98,6 +98,12 @@ class DataSet:
                 self._order = self._rng.permutation(self.num_examples)
                 self._pos = 0
                 self.epochs_completed += 1
+        return idx
+
+    def next_batch(self, batch_size: int) -> tuple[np.ndarray, np.ndarray]:
+        """(float32 images in [0,1], one-hot or int64 labels) — the
+        reference tutorial API (``MNISTDist.py:178``)."""
+        idx = self._next_indices(batch_size)
         xs = self._gather(idx)
         if self.one_hot:
             ys = None
@@ -111,6 +117,32 @@ class DataSet:
         else:
             ys = self.labels_int[idx]
         return xs, ys
+
+    def next_batch_raw(self, batch_size: int) -> tuple[np.ndarray, np.ndarray]:
+        """(uint8 images, int32 class ids) — the thin-wire batch format.
+
+        Host->device traffic per example drops from 3176 B (f32 pixels +
+        one-hot f32) to 788 B; models normalize on device (uint8 inputs are
+        recognized in ``apply``) and the loss/accuracy ops accept integer
+        labels. On tunneled or PCIe-attached accelerators the input link is
+        the throughput ceiling, so this is the fast path ``bench.py`` and
+        ``--raw_input`` use. Same shuffled-epoch index stream as
+        ``next_batch``.
+        """
+        idx = self._next_indices(batch_size)
+        return self._raw_u8()[idx], self.labels_int[idx].astype(np.int32)
+
+    def _raw_u8(self) -> np.ndarray:
+        if self._images_u8 is not None:
+            return self._images_u8  # native u8 source: exact bytes
+        if getattr(self, "_u8_cache", None) is None:
+            # one-time quantization of float-stored sources (synthetic /
+            # CIFAR pickles); kept separate from _images_u8 so the f32
+            # next_batch path stays exactly as loaded
+            self._u8_cache = np.clip(
+                np.round(self._images_f32 * 255.0), 0, 255
+            ).astype(np.uint8).reshape(len(self._images_f32), -1)
+        return self._u8_cache
 
     def _gather(self, idx: np.ndarray) -> np.ndarray:
         if self._images_u8 is not None:

@@ -17,9 +17,12 @@ from typing import Callable, Iterator
 import jax
 
 
-def batch_iterator(dataset, batch_size: int) -> Iterator:
+def batch_iterator(dataset, batch_size: int, raw: bool = False) -> Iterator:
+    """Endless minibatch stream; ``raw=True`` yields thin-wire (uint8,
+    int32) batches (see DataSet.next_batch_raw)."""
+    draw = dataset.next_batch_raw if raw else dataset.next_batch
     while True:
-        yield dataset.next_batch(batch_size)
+        yield draw(batch_size)
 
 
 _END = object()
@@ -69,6 +72,9 @@ def prefetch_to_device(
 
     t = threading.Thread(target=_worker, daemon=True)
     t.start()
+    # bound locally: module globals (queue.Empty) may already be torn down
+    # when a leaked generator is finalized at interpreter shutdown
+    empty_exc = queue.Empty
     try:
         while True:
             item = q.get()
@@ -83,5 +89,5 @@ def prefetch_to_device(
         try:
             while True:
                 q.get_nowait()
-        except queue.Empty:
+        except empty_exc:
             pass

@@ -97,6 +97,7 @@ class DeepCNN:
         """
         w, b = params["weights"], params["biases"]
         cd = self.compute_dtype
+        x = nn.normalize_if_u8(x, cd)
         x = x.reshape(-1, self.image_size, self.image_size, self.channels)
 
         x = nn.conv2d(x, w["wc1"], b["bc1"], compute_dtype=cd)

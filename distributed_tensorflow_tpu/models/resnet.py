@@ -137,6 +137,7 @@ class ResNet:
         assert state is not None, "ResNet.apply needs the state collection"
 
         new_state: dict = {"stem": {}, }
+        x = nn.normalize_if_u8(x, self.compute_dtype)
         x = x.reshape(-1, self.image_size, self.image_size, self.channels)
 
         h = self._conv(x, params["stem"]["conv"])

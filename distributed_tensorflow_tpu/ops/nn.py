@@ -81,6 +81,16 @@ def dense(x, w, b=None, *, compute_dtype=None):
     return y
 
 
+def normalize_if_u8(x, compute_dtype=None):
+    """Thin-wire input contract, shared by every model's ``apply``: uint8
+    pixels that crossed the host->device link raw are normalized to [0,1]
+    on device (the scale fuses into the first conv/matmul); any other
+    dtype passes through untouched."""
+    if x.dtype == jnp.uint8:
+        return x.astype(compute_dtype or jnp.float32) / 255.0
+    return x
+
+
 def dropout(x, keep_prob, rng, *, deterministic: bool = False):
     """Inverted dropout (reference ``tf.nn.dropout``, MNISTDist.py:86).
 
@@ -97,20 +107,33 @@ def dropout(x, keep_prob, rng, *, deterministic: bool = False):
     return jnp.where(mask, x * scale, jnp.zeros_like(x))
 
 
-def softmax_cross_entropy(logits, labels_onehot):
+def softmax_cross_entropy(logits, labels):
     """Mean softmax cross-entropy over the batch (reference cost, MNISTDist.py:148).
 
-    Numerically-stable log-softmax form; XLA fuses the whole reduction.
+    ``labels`` may be one-hot [B, C] (reference parity) or integer class
+    ids [B] (the thin-wire input path: int labels cost 1/40th the
+    host->device bytes of one-hot f32). Numerically-stable log-softmax
+    form; XLA fuses the whole reduction.
     """
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    per_example = -jnp.sum(labels_onehot.astype(jnp.float32) * logp, axis=-1)
+    if labels.ndim == logits.ndim - 1:  # integer class ids
+        gathered = jnp.take_along_axis(
+            logp, labels[..., None].astype(jnp.int32), axis=-1
+        )
+        per_example = -gathered[..., 0]
+    else:
+        per_example = -jnp.sum(labels.astype(jnp.float32) * logp, axis=-1)
     return jnp.mean(per_example)
 
 
-def accuracy(logits, labels_onehot):
-    """Minibatch argmax-equality accuracy (reference, MNISTDist.py:152-153)."""
+def accuracy(logits, labels):
+    """Minibatch argmax-equality accuracy (reference, MNISTDist.py:152-153).
+    ``labels``: one-hot [B, C] or integer class ids [B]."""
     pred = jnp.argmax(logits, axis=-1)
-    true = jnp.argmax(labels_onehot, axis=-1)
+    if labels.ndim == logits.ndim - 1:
+        true = labels.astype(pred.dtype)
+    else:
+        true = jnp.argmax(labels, axis=-1)
     return jnp.mean((pred == true).astype(jnp.float32))
 
 

@@ -41,7 +41,11 @@ from distributed_tensorflow_tpu.training import (
 )
 from distributed_tensorflow_tpu.training.supervisor import Supervisor
 from distributed_tensorflow_tpu.training.train_state import evaluate
-from distributed_tensorflow_tpu.utils import MetricsLogger, Throughput
+from distributed_tensorflow_tpu.utils import (
+    MetricsLogger,
+    Throughput,
+    collective_sync_cadence,
+)
 
 
 @dataclass
@@ -142,11 +146,14 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         # next_batch (the feed-dict bottleneck this build eliminates,
         # SURVEY.md §3.4)
         batches = prefetch_to_device(
-            batch_iterator(ds.train, feed_batch), size=2, stage=stage
+            batch_iterator(ds.train, feed_batch, raw=FLAGS.raw_input),
+            size=2,
+            stage=stage,
         )
         profiling = False
         profile_done = not FLAGS.profile_dir
         compile_done = False
+        sync_every = collective_sync_cadence(mode == "sync")
         try:
             meter.reset()
             while not should_stop() and step < FLAGS.training_iter:
@@ -164,6 +171,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 state, _ = step_fn(state, batch)
                 step += 1
                 meter.step()
+                if sync_every and step % sync_every == 0:
+                    jax.block_until_ready(state.params)
                 if not compile_done:
                     # first step carries XLA compile; keep it out of the
                     # throughput window

@@ -1,4 +1,14 @@
 from distributed_tensorflow_tpu.utils.metrics import MetricsLogger, reference_log_line
-from distributed_tensorflow_tpu.utils.profiling import StepTimer, Throughput
+from distributed_tensorflow_tpu.utils.profiling import (
+    StepTimer,
+    Throughput,
+    collective_sync_cadence,
+)
 
-__all__ = ["MetricsLogger", "reference_log_line", "StepTimer", "Throughput"]
+__all__ = [
+    "MetricsLogger",
+    "reference_log_line",
+    "StepTimer",
+    "Throughput",
+    "collective_sync_cadence",
+]

@@ -59,6 +59,21 @@ class Throughput:
         return self.images_per_sec / max(self.n_chips, 1)
 
 
+def collective_sync_cadence(multi_device: bool) -> int:
+    """How often (in steps) a multi-device training loop must
+    ``block_until_ready`` to bound in-flight collective programs; 0 = never.
+
+    XLA:CPU runs each virtual device on a pool thread and collective
+    programs rendezvous across all of them; dozens of concurrently enqueued
+    mesh programs can interleave across device threads and deadlock the
+    rendezvous (observed at ~60 deep on an 8-device host — PERF.md). TPU
+    streams execute strictly in enqueue order per chip, so no cap there.
+    """
+    if not multi_device:
+        return 0
+    return 16 if jax.default_backend() == "cpu" else 0
+
+
 @contextlib.contextmanager
 def trace(logdir: str | None):
     """jax.profiler trace scope; no-op when logdir is falsy."""

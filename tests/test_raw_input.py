@@ -1,0 +1,122 @@
+"""Thin-wire input path: uint8 pixels + int32 labels end to end.
+
+The raw path exists because the host->device link, not the MXU, bounds
+throughput for small models (PERF.md); these tests pin its semantics:
+int-label loss/accuracy == one-hot loss/accuracy, u8 model inputs ==
+normalized f32 inputs, and the raw batch stream draws the same shuffled
+indices as the reference-parity float stream.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.datasets import DataSet
+from distributed_tensorflow_tpu.models import DeepCNN
+from distributed_tensorflow_tpu.ops import nn
+
+
+@pytest.fixture(scope="module")
+def logits_labels():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(64, 10)), jnp.float32)
+    ints = rng.integers(0, 10, 64)
+    onehot = np.zeros((64, 10), np.float32)
+    onehot[np.arange(64), ints] = 1.0
+    return logits, jnp.asarray(ints, jnp.int32), jnp.asarray(onehot)
+
+
+def test_cross_entropy_int_equals_onehot(logits_labels):
+    logits, ints, onehot = logits_labels
+    a = float(nn.softmax_cross_entropy(logits, onehot))
+    b = float(nn.softmax_cross_entropy(logits, ints))
+    assert a == pytest.approx(b, rel=1e-6)
+
+
+def test_accuracy_int_equals_onehot(logits_labels):
+    logits, ints, onehot = logits_labels
+    assert float(nn.accuracy(logits, onehot)) == float(nn.accuracy(logits, ints))
+
+
+def test_next_batch_raw_same_index_stream():
+    """raw and float streams draw identical shuffled epochs from the same
+    seed; u8-sourced images match exactly (f32 = u8/255)."""
+    rng = np.random.default_rng(1)
+    images = rng.integers(0, 256, (50, 784), np.uint8)
+    labels = rng.integers(0, 10, 50).astype(np.int64)
+    a = DataSet(images.copy(), labels.copy(), one_hot=True, seed=7)
+    b = DataSet(images.copy(), labels.copy(), one_hot=True, seed=7)
+    for _ in range(4):  # crosses an epoch boundary (50 examples, bs 16)
+        xf, yf = a.next_batch(16)
+        xu, yu = b.next_batch_raw(16)
+        # f32 path may scale by the reciprocal (native gather); 1-ulp-level
+        # agreement with u8/255 is the contract
+        np.testing.assert_allclose(xf, xu.astype(np.float32) / 255.0,
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_array_equal(np.argmax(yf, axis=1), yu)
+        assert xu.dtype == np.uint8 and yu.dtype == np.int32
+
+
+def test_next_batch_raw_float_source_quantizes_without_side_effects():
+    rng = np.random.default_rng(2)
+    images = rng.random((20, 784)).astype(np.float32)
+    labels = rng.integers(0, 10, 20).astype(np.int64)
+    ds = DataSet(images, labels, one_hot=True, seed=0)
+    xu, yu = ds.next_batch_raw(8)
+    assert xu.dtype == np.uint8
+    # the float path must still serve the ORIGINAL float values afterwards
+    xf, _ = ds.next_batch(8)
+    assert xf.dtype == np.float32
+    assert np.isin(xf, images).all()
+
+
+def test_model_accepts_uint8_equals_normalized_float():
+    model = DeepCNN()
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(3)
+    xu = rng.integers(0, 256, (4, 784), np.uint8)
+    xf = xu.astype(np.float32) / 255.0
+    lu = model.apply(params, jnp.asarray(xu))
+    lf = model.apply(params, jnp.asarray(xf))
+    np.testing.assert_allclose(np.asarray(lu), np.asarray(lf), rtol=1e-6, atol=1e-6)
+
+
+def test_train_step_raw_batch_reduces_loss():
+    from distributed_tensorflow_tpu.training import adam, create_train_state, make_train_step
+
+    model = DeepCNN()
+    opt = adam(2e-3)
+    state = create_train_state(model, opt, seed=0)
+    step = make_train_step(model, opt, keep_prob=1.0)
+    rng = np.random.default_rng(4)
+    x = rng.integers(0, 256, (64, 784), np.uint8)
+    y = rng.integers(0, 10, 64).astype(np.int32)
+    first = None
+    for _ in range(40):
+        state, m = step(state, (x, y))
+        if first is None:
+            first = float(m["loss"])
+    assert float(m["loss"]) < first * 0.5
+
+
+def test_train_loop_raw_input_flag(tmp_path):
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    flags.FLAGS._reset()
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/logs",
+        f"--data_dir={tmp_path}/no-data",
+        "--training_iter=12",
+        "--batch_size=32",
+        "--display_step=4",
+        "--optimizer=adam",
+        "--raw_input=true",
+        "--save_model_secs=100000",
+    ])
+    res = train(flags.FLAGS, mode="sync")
+    assert res.final_step == 12
+    assert res.train_metrics["loss"] > 0
+    flags.FLAGS._reset()
