@@ -8,27 +8,33 @@ synchronization between workers (stale-gradient async SGD), termination on
 a shared global step.
 
 TPU-native emulation: compute (forward/backward) is a jitted XLA function
-on the worker's TPU chips; parameter state and the SGD update live on the
-ps *hosts* (numpy, like TF's ps-side C++ kernels ran on CPU in the
-reference deployment). Transport is a small length-prefixed-pickle TCP
-protocol over DCN — playing the role of TF's gRPC Send/Recv. Sharding is
-round-robin over parameter leaves across ps tasks, the
-``replica_device_setter`` policy (``MNISTDist.py:110-111``).
+on the worker's TPU chips — ALL local chips when the worker host has more
+than one (batch sharded over a local mesh, grads pmean'd before the push;
+the reference's 1-GPU-per-worker topology is the degenerate case). The
+parameter state and the optimizer update live on the ps *hosts* (numpy,
+like TF's ps-side C++ kernels ran on CPU in the reference deployment).
+Transport is a typed length-prefixed TCP protocol over DCN — a JSON
+header plus raw little-endian tensor bytes — playing the role of TF's
+gRPC Send/Recv + protobuf. (No pickle anywhere: a peer that can reach the
+port can corrupt training, as with TF's unauthenticated gRPC runtime, but
+cannot execute code via deserialization.) Sharding is round-robin over
+parameter leaves across ps tasks, the ``replica_device_setter`` policy
+(``MNISTDist.py:110-111``).
 
 Chief semantics (``MNISTDist.py:159,169-170``): worker 0 initializes (or
-restores a checkpoint) and pushes the initial params to the ps tasks;
-non-chief workers wait until the ps reports initialized. The shared
-global_step lives on ps task 0 and increments once per applied push, so
-``training_iter`` bounds TOTAL steps across all workers, exactly like the
-reference (``:173,188``).
-
-This transport is an in-cluster emulation protocol (pickle): run it only
-on trusted training networks, as with TF's unauthenticated gRPC runtime.
+restores a checkpoint) and pushes the initial params + the optimizer
+config to the ps tasks; non-chief workers wait until every ps reports
+initialized. The shared global_step lives on ps task 0 and increments
+once per applied push, so ``training_iter`` bounds TOTAL steps across all
+workers, exactly like the reference (``:173,188``). The ps applies the
+configured optimizer (sgd parity with ApplyGradientDescent,
+MNISTDist.py:149; momentum/adam as extensions with slots resident on the
+owning ps shard).
 """
 
 from __future__ import annotations
 
-import pickle
+import json
 import socket
 import socketserver
 import struct
@@ -42,18 +48,64 @@ from distributed_tensorflow_tpu.checkpoint import Checkpointer
 
 _LEN = struct.Struct(">Q")
 
-
 # ---------------------------------------------------------------- protocol
+#
+# frame := u64 header_len | header_json | concatenated array bytes
+#
+# The header carries every JSON-safe field of the message dict plus, under
+# "_arrays", the layout {field: {key: [dtype, shape]}} of each dict-of-
+# ndarray field; array payloads follow in header order as raw C-order
+# little-endian bytes. Deserialization allocates from the declared dtypes/
+# shapes only — there is no object deserialization of any kind.
 
-def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+_MAX_FRAME = 1 << 33  # 8 GiB sanity bound per message
 
 
-def _recv_msg(sock: socket.socket):
-    header = _recv_exact(sock, _LEN.size)
-    (n,) = _LEN.unpack(header)
-    return pickle.loads(_recv_exact(sock, n))
+def _encode_msg(obj: dict) -> bytes:
+    meta: dict = {}
+    arrays: dict[str, dict[str, np.ndarray]] = {}
+    layout: dict[str, dict[str, list]] = {}
+    for field, value in obj.items():
+        if isinstance(value, dict) and all(
+            isinstance(v, np.ndarray) for v in value.values()
+        ):
+            arrs = {k: np.ascontiguousarray(v) for k, v in value.items()}
+            arrays[field] = arrs
+            layout[field] = {
+                k: [a.dtype.str, list(a.shape)] for k, a in arrs.items()
+            }
+        else:
+            meta[field] = value  # must be JSON-serializable by construction
+    header = json.dumps({"meta": meta, "_arrays": layout}).encode()
+    parts = [_LEN.pack(len(header)), header]
+    for field in layout:
+        for k in layout[field]:
+            parts.append(arrays[field][k].tobytes())
+    return b"".join(parts)
+
+
+def _send_msg(sock: socket.socket, obj: dict) -> None:
+    sock.sendall(_encode_msg(obj))
+
+
+def _recv_msg(sock: socket.socket) -> dict:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > _MAX_FRAME:
+        raise ConnectionError(f"oversized header ({n} bytes)")
+    header = json.loads(_recv_exact(sock, n))
+    msg = dict(header["meta"])
+    for field, entries in header["_arrays"].items():
+        out = {}
+        for k, (dtype_str, shape) in entries.items():
+            dt = np.dtype(dtype_str)
+            count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            nbytes = dt.itemsize * count
+            if nbytes > _MAX_FRAME:
+                raise ConnectionError(f"oversized tensor {field}.{k}")
+            buf = _recv_exact(sock, nbytes)
+            out[k] = np.frombuffer(buf, dtype=dt).reshape(shape).copy()
+        msg[field] = out
+    return msg
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -99,16 +151,59 @@ class _ThreadedTCP(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
+class _PsOptimizer:
+    """Host-side optimizer applied on the owning ps shard — the
+    generalization of the reference's ps-side ApplyGradientDescent
+    (MNISTDist.py:149). Slot state (momentum/adam moments) lives with the
+    param shard, mirroring how TF keeps slot Variables on the ps."""
+
+    NAMES = ("sgd", "momentum", "adam")
+
+    def __init__(self, name: str, lr: float):
+        if name not in self.NAMES:
+            raise ValueError(f"unknown optimizer {name!r}")
+        self.name = name
+        self.lr = float(lr)
+        self._slots: dict[str, dict[str, np.ndarray]] = {}
+        self._t: dict[str, int] = {}
+
+    def apply(self, key: str, param: np.ndarray, grad: np.ndarray) -> None:
+        g = np.asarray(grad, dtype=np.float32)
+        if self.name == "sgd":
+            param -= self.lr * g
+            return
+        slots = self._slots.setdefault(key, {})
+        if self.name == "momentum":
+            v = slots.setdefault("v", np.zeros_like(param))
+            v *= 0.9
+            v += g
+            param -= self.lr * v
+            return
+        # adam (matches training.train_state.adam)
+        m = slots.setdefault("m", np.zeros_like(param))
+        v = slots.setdefault("v", np.zeros_like(param))
+        t = self._t.get(key, 0) + 1
+        self._t[key] = t
+        m *= 0.9
+        m += 0.1 * g
+        v *= 0.999
+        v += 0.001 * g * g
+        scale = self.lr * np.sqrt(1.0 - 0.999**t) / (1.0 - 0.9**t)
+        param -= scale * m / (np.sqrt(v) + 1e-8)
+
+
 class PSServer:
     """One parameter-server task: owns a shard of param leaves + (task 0
-    only) the shared global step. Applies vanilla SGD on push — the
-    reference's ps-side ApplyGradientDescent (MNISTDist.py:149)."""
+    only) the shared global step. Applies the configured optimizer on push
+    — the reference's ps-side ApplyGradientDescent (MNISTDist.py:149),
+    generalized to momentum/adam with ps-resident slots."""
 
     def __init__(self, task_index: int, bind_address: str):
         self.task_index = task_index
         host, port = bind_address.rsplit(":", 1)
         self._lock = threading.Lock()
         self.params: dict[str, np.ndarray] = {}
+        self.optimizer: _PsOptimizer | None = None
         self.initialized = False
         self.global_step = 0  # authoritative only on task 0
         self._shutdown = threading.Event()
@@ -124,8 +219,19 @@ class PSServer:
         op = msg.get("op")
         with self._lock:
             if op == "ping":
-                return {"ok": True, "task": self.task_index}
+                # carries readiness so clients can poll initialization
+                # without transferring the shard (a full pull per poll was
+                # the old behavior)
+                return {"ok": True, "task": self.task_index,
+                        "initialized": self.initialized}
             if op == "init_shard":
+                try:
+                    self.optimizer = _PsOptimizer(
+                        msg.get("optimizer", "sgd"),
+                        msg.get("learning_rate", 0.001),
+                    )
+                except ValueError as e:
+                    return {"ok": False, "error": str(e)}
                 self.params = {k: np.array(v, dtype=np.float32)
                                for k, v in msg["params"].items()}
                 self.initialized = True
@@ -133,8 +239,8 @@ class PSServer:
             if op == "pull":
                 if not self.initialized:
                     return {"ok": False, "uninitialized": True}
-                # snapshot under the lock: the response is pickled after the
-                # lock is released, and concurrent pushes mutate these
+                # snapshot under the lock: the response is serialized after
+                # the lock is released, and concurrent pushes mutate these
                 # arrays in place — copying prevents serving torn tensors
                 return {"ok": True,
                         "params": {k: v.copy() for k, v in self.params.items()},
@@ -142,10 +248,9 @@ class PSServer:
             if op == "push_grads":
                 if not self.initialized:
                     return {"ok": False, "uninitialized": True}
-                lr = float(msg["lr"])
                 for k, g in msg["grads"].items():
                     if k in self.params:
-                        self.params[k] -= lr * np.asarray(g, dtype=np.float32)
+                        self.optimizer.apply(k, self.params[k], g)
                 if msg.get("count_step", False):
                     self.global_step += 1
                 return {"ok": True, "global_step": self.global_step}
@@ -218,20 +323,23 @@ class PSClient:
         for i in range(len(self.addresses)):
             self.call(i, {"op": "ping"})
 
-    def init_params(self, flat: dict[str, np.ndarray], assignment: dict[str, int]):
+    def init_params(self, flat: dict[str, np.ndarray], assignment: dict[str, int],
+                    optimizer: str = "sgd", learning_rate: float = 0.001):
         for i in range(len(self.addresses)):
             shard = {k: v for k, v in flat.items() if assignment[k] == i}
-            self.call(i, {"op": "init_shard", "params": shard})
+            r = self.call(i, {"op": "init_shard", "params": shard,
+                              "optimizer": optimizer,
+                              "learning_rate": learning_rate})
+            if not r.get("ok"):
+                raise ValueError(f"ps {i} rejected init: {r.get('error')}")
 
     def wait_initialized(self, poll_s: float = 0.3):
         """Non-chief behavior: wait for the chief's init (MNISTDist.py:170).
         Polls EVERY ps task — the chief initializes them in order, so ps 0
-        answering ok does not imply the later shards are ready."""
+        answering ok does not imply the later shards are ready. Uses the
+        lightweight ping status, not a full shard transfer."""
         for i in range(len(self.addresses)):
-            while True:
-                r = self.call(i, {"op": "pull"})
-                if r.get("ok"):
-                    break
+            while not self.call(i, {"op": "ping"}).get("initialized"):
                 time.sleep(poll_s)
 
     def pull_all(self) -> tuple[dict[str, np.ndarray], int]:
@@ -247,12 +355,13 @@ class PSClient:
         return flat, step
 
     def push_grads(self, flat_grads: dict[str, np.ndarray],
-                   assignment: dict[str, int], lr: float) -> int:
-        """Push each grad to its owning ps; ps 0 counts the global step."""
+                   assignment: dict[str, int]) -> int:
+        """Push each grad to its owning ps (which applies its configured
+        optimizer); ps 0 counts the global step."""
         step = -1
         for i in range(len(self.addresses)):
             shard = {k: v for k, v in flat_grads.items() if assignment[k] == i}
-            r = self.call(i, {"op": "push_grads", "grads": shard, "lr": lr,
+            r = self.call(i, {"op": "push_grads", "grads": shard,
                               "count_step": i == 0})
             if i == 0:
                 step = r["global_step"]
@@ -282,18 +391,29 @@ class PSClient:
 
 def run_parameter_server(cluster, FLAGS):
     """The ps role: bind, serve params, block forever
-    (MNISTDist.py:105-106)."""
+    (MNISTDist.py:105-106). Binds the advertised interface (not 0.0.0.0) so
+    the service is only reachable on the address the cluster spec names."""
     addr = cluster.task_address("ps", FLAGS.task_index)
-    # bind on the port of our advertised address, all interfaces
-    port = addr.rsplit(":", 1)[1]
-    server = PSServer(FLAGS.task_index, f"0.0.0.0:{port}")
+    server = PSServer(FLAGS.task_index, addr)
     print(f"ps/{FLAGS.task_index} serving at {addr}")
     server.serve_forever()
 
 
-def make_grad_fn(model, keep_prob: float):
-    """Jitted (params, batch, rng) -> (grads, metrics) — the worker-side
-    compute graph, XLA-compiled for the local TPU."""
+def make_grad_fn(model, keep_prob: float, devices=None):
+    """(params, batch, rng) -> (grads, metrics) — the worker-side compute,
+    XLA-compiled for the local TPU chips.
+
+    With more than one local device the batch is sharded over a local
+    ("data",) mesh and the grads are pmean'd across the chips before
+    returning — one push per worker regardless of chip count (the
+    reference's 1-GPU-per-worker topology is the 1-chip case; a TPU VM
+    worker uses all its chips). Returned grads equal the single-device
+    grads on the same batch (pmean of per-shard means).
+    """
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS
     from distributed_tensorflow_tpu.training.train_state import loss_and_metrics
 
     if getattr(model, "stateful", False):
@@ -302,8 +422,10 @@ def make_grad_fn(model, keep_prob: float):
             "deep CNN); stateful models (batch-norm ResNets) use sync mode"
         )
 
-    @jax.jit
-    def grad_fn(params, batch, rng):
+    if devices is None:
+        devices = jax.local_devices()
+
+    def per_example_grads(params, batch, rng):
         def loss_fn(p):
             return loss_and_metrics(model, p, batch, keep_prob=keep_prob,
                                     rng=rng, train=True)
@@ -311,7 +433,25 @@ def make_grad_fn(model, keep_prob: float):
         grads, aux = jax.grad(loss_fn, has_aux=True)(params)
         return grads, aux["metrics"]
 
-    return grad_fn
+    if len(devices) <= 1:
+        return jax.jit(per_example_grads)
+
+    mesh = Mesh(np.asarray(devices).reshape(len(devices)), (DATA_AXIS,))
+
+    def per_shard(params, batch, rng):
+        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+        grads, metrics = per_example_grads(params, batch, rng)
+        return lax.pmean(grads, DATA_AXIS), lax.pmean(metrics, DATA_AXIS)
+
+    return jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(P(), (P(DATA_AXIS), P(DATA_AXIS)), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
 
 
 def run_worker(cluster, FLAGS) -> int:
@@ -341,15 +481,24 @@ def run_worker(cluster, FLAGS) -> int:
         restored = ckpt.restore({"params": template, "step": 0})
         if restored is not None:
             blob, _ = restored
-            client.init_params(flatten_params(blob["params"]), assignment)
+            client.init_params(flatten_params(blob["params"]), assignment,
+                               optimizer=FLAGS.optimizer,
+                               learning_rate=FLAGS.learning_rate)
             client.call(0, {"op": "set_step", "global_step": int(np.asarray(blob["step"]))})
             print(f"worker/0 restored checkpoint at step {int(np.asarray(blob['step']))}")
         else:
-            client.init_params(flat_template, assignment)
+            client.init_params(flat_template, assignment,
+                               optimizer=FLAGS.optimizer,
+                               learning_rate=FLAGS.learning_rate)
     else:
         client.wait_initialized()
 
-    grad_fn = make_grad_fn(model, FLAGS.keep_prob)
+    n_local = len(jax.local_devices())
+    use_local_mesh = n_local > 1 and FLAGS.batch_size % n_local == 0
+    grad_fn = make_grad_fn(
+        model, FLAGS.keep_prob,
+        devices=None if use_local_mesh else jax.local_devices()[:1],
+    )
     eval_fn = make_eval_step(model)
     logger = MetricsLogger(FLAGS.logdir if is_chief else None,
                            job_name="worker", task_index=FLAGS.task_index)
@@ -362,16 +511,18 @@ def run_worker(cluster, FLAGS) -> int:
     step = client.get_step()
     while step < FLAGS.training_iter:
         batch = train_data.next_batch(FLAGS.batch_size)
-        flat, step = client.pull_all()
+        flat, pull_step = client.pull_all()
+        step = pull_step
         params = unflatten_params(template, flat)
         if step % FLAGS.display_step == 0:
             m = eval_fn(params, batch)
             logger.log_display(step, float(m["loss"]), float(m["accuracy"]))
         rng, sub = jax.random.split(rng)
         grads, _ = grad_fn(params, batch, sub)
-        step = client.push_grads(flatten_params(grads), assignment,
-                                 FLAGS.learning_rate)
-        ckpt.maybe_save({"params": params, "step": step}, step)
+        step = client.push_grads(flatten_params(grads), assignment)
+        # checkpoint the pulled snapshot under the step it corresponds to
+        # (pull_step), not the post-push counter
+        ckpt.maybe_save({"params": params, "step": pull_step}, pull_step)
 
     if is_chief:
         flat, step = client.pull_all()
