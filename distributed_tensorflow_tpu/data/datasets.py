@@ -58,9 +58,23 @@ class DataSet:
         self.one_hot = one_hot
         self.num_classes = num_classes
         self._rng = np.random.default_rng(seed)
-        self._order = self._rng.permutation(images.shape[0])
+        self._order = self._fresh_order(images.shape[0])
         self._pos = 0
         self.epochs_completed = 0
+
+    def _fresh_order(self, n: int) -> np.ndarray:
+        """Epoch shuffle order. The permutation itself runs in the native
+        C++ data plane (Fisher-Yates, fastdata.cpp) when the library is
+        built, NumPy otherwise; each epoch's sub-seed is drawn from this
+        DataSet's seeded generator either way, so the stream is
+        deterministic per (seed, backend)."""
+        from distributed_tensorflow_tpu import native
+
+        sub_seed = int(self._rng.integers(0, 2**63 - 1))
+        order = native.permutation(n, sub_seed)
+        if order is None:
+            order = np.random.default_rng(sub_seed).permutation(n)
+        return order
 
     @property
     def images(self) -> np.ndarray:
@@ -95,7 +109,7 @@ class DataSet:
             self._pos += take
             filled += take
             if self._pos >= len(self._order):
-                self._order = self._rng.permutation(self.num_examples)
+                self._order = self._fresh_order(self.num_examples)
                 self._pos = 0
                 self.epochs_completed += 1
         return idx

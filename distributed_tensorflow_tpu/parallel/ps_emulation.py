@@ -69,7 +69,10 @@ def _encode_msg(obj: dict) -> bytes:
         if isinstance(value, dict) and all(
             isinstance(v, np.ndarray) for v in value.values()
         ):
-            arrs = {k: np.ascontiguousarray(v) for k, v in value.items()}
+            # asarray, not ascontiguousarray: the latter promotes 0-d to 1-d
+            # and would drop scalar shapes on the wire; tobytes() already
+            # serializes any layout as C-order
+            arrs = {k: np.asarray(v) for k, v in value.items()}
             arrays[field] = arrs
             layout[field] = {
                 k: [a.dtype.str, list(a.shape)] for k, a in arrs.items()
@@ -155,9 +158,20 @@ class _PsOptimizer:
     """Host-side optimizer applied on the owning ps shard — the
     generalization of the reference's ps-side ApplyGradientDescent
     (MNISTDist.py:149). Slot state (momentum/adam moments) lives with the
-    param shard, mirroring how TF keeps slot Variables on the ps."""
+    param shard, mirroring how TF keeps slot Variables on the ps.
 
-    NAMES = ("sgd", "momentum", "adam")
+    Deliberately NumPy-only (a ps host need not own an accelerator), so the
+    math here re-states training/train_state.py's optimizers with their
+    default hyperparameters; trajectory equality against the device-side
+    versions is pinned by tests/test_ps_emulation.py
+    (test_ps_optimizer_matches_device_optimizer) — change either side and
+    that test fails."""
+
+    # the device-side registry is the source of truth for what exists
+    from distributed_tensorflow_tpu.training.train_state import (
+        _OPTIMIZERS as _DEVICE_REGISTRY,
+    )
+    NAMES = tuple(sorted(_DEVICE_REGISTRY))
 
     def __init__(self, name: str, lr: float):
         if name not in self.NAMES:
@@ -207,7 +221,15 @@ class PSServer:
         self.initialized = False
         self.global_step = 0  # authoritative only on task 0
         self._shutdown = threading.Event()
-        self._server = _ThreadedTCP((host, int(port)), _Handler)
+        try:
+            self._server = _ThreadedTCP((host, int(port)), _Handler)
+        except OSError:
+            # the advertised name is not locally assignable (NAT / bridge /
+            # load-balancer address): serve on all interfaces at the
+            # advertised port instead — the reference's gRPC server behavior
+            print(f"ps/{task_index}: {host} not locally assignable; "
+                  f"binding 0.0.0.0:{port}")
+            self._server = _ThreadedTCP(("0.0.0.0", int(port)), _Handler)
         self._server.ps = self  # type: ignore[attr-defined]
 
     @property
@@ -495,6 +517,10 @@ def run_worker(cluster, FLAGS) -> int:
 
     n_local = len(jax.local_devices())
     use_local_mesh = n_local > 1 and FLAGS.batch_size % n_local == 0
+    if n_local > 1 and not use_local_mesh:
+        print(f"worker/{FLAGS.task_index}: --batch_size={FLAGS.batch_size} is "
+              f"not divisible by the {n_local} local chips; computing on ONE "
+              f"chip. Use a multiple of {n_local} to engage the local mesh.")
     grad_fn = make_grad_fn(
         model, FLAGS.keep_prob,
         devices=None if use_local_mesh else jax.local_devices()[:1],

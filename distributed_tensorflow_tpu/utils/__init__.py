@@ -1,6 +1,5 @@
 from distributed_tensorflow_tpu.utils.metrics import MetricsLogger, reference_log_line
 from distributed_tensorflow_tpu.utils.profiling import (
-    StepTimer,
     Throughput,
     collective_sync_cadence,
 )
@@ -8,7 +7,6 @@ from distributed_tensorflow_tpu.utils.profiling import (
 __all__ = [
     "MetricsLogger",
     "reference_log_line",
-    "StepTimer",
     "Throughput",
     "collective_sync_cadence",
 ]

@@ -1,37 +1,16 @@
-"""Step timing / throughput meters + jax.profiler hooks.
+"""Throughput metering + the collective in-flight cap.
 
 The reference has no tracing or profiling at all (``import time`` at
-MNISTDist.py:8 is dead — SURVEY.md §5). The build needs them for the
-BASELINE metric (images/sec/chip), so they are first-class here.
+MNISTDist.py:8 is dead — SURVEY.md §5). The build needs metering for the
+BASELINE metric (images/sec/chip); jax.profiler tracing is driven directly
+by the training loop via ``--profile_dir`` (training/loop.py).
 """
 
 from __future__ import annotations
 
-import contextlib
 import time
 
 import jax
-
-
-class StepTimer:
-    """Wall-clock per-step timer that excludes the first (compile) step."""
-
-    def __init__(self):
-        self.times: list[float] = []
-        self._t0 = None
-
-    def start(self):
-        self._t0 = time.perf_counter()
-
-    def stop(self):
-        if self._t0 is not None:
-            self.times.append(time.perf_counter() - self._t0)
-            self._t0 = None
-
-    @property
-    def mean_step_s(self) -> float:
-        steady = self.times[1:] if len(self.times) > 1 else self.times
-        return sum(steady) / max(len(steady), 1)
 
 
 class Throughput:
@@ -72,16 +51,3 @@ def collective_sync_cadence(multi_device: bool) -> int:
     if not multi_device:
         return 0
     return 16 if jax.default_backend() == "cpu" else 0
-
-
-@contextlib.contextmanager
-def trace(logdir: str | None):
-    """jax.profiler trace scope; no-op when logdir is falsy."""
-    if not logdir:
-        yield
-        return
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()

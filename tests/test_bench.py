@@ -1,0 +1,53 @@
+"""bench.py phases exercised on the 8-device virtual mesh (weak spot from
+round 1: the multi-chip branch only ran when real hardware had >1 chip).
+Constants are shrunk via monkeypatch; the point is that every branch —
+mesh build, sharded prefetch staging, dp eval on the device-resident test
+set, the feed-dict baseline — compiles and executes, not the numbers."""
+
+import jax
+import numpy as np
+import pytest
+
+import bench
+from distributed_tensorflow_tpu.data import read_data_sets
+
+
+@pytest.fixture(scope="module")
+def ds(tmp_path_factory):
+    # synthetic (no IDX files in the tmp dir); 2000-example test split is
+    # divisible by 8 so convergence_phase takes the dp-eval branch
+    return read_data_sets(str(tmp_path_factory.mktemp("no-data")), one_hot=True)
+
+
+@pytest.mark.parametrize("n_chips", [1, 8])
+def test_throughput_phase_runs(monkeypatch, ds, n_chips):
+    monkeypatch.setattr(bench, "PER_CHIP_BATCH", 16)
+    monkeypatch.setattr(bench, "TIMED_STEPS", 4)
+    rate = bench.throughput_phase(ds, n_chips)
+    assert rate > 0 and np.isfinite(rate)
+
+
+@pytest.mark.parametrize("n_chips", [1, 8])
+def test_convergence_phase_runs(monkeypatch, ds, n_chips):
+    monkeypatch.setattr(bench, "CONVERGE_BATCH", 16)
+    monkeypatch.setattr(bench, "CONVERGE_MAX_STEPS", 12)
+    monkeypatch.setattr(bench, "CONVERGE_EVAL_EVERY", 6)
+    out = bench.convergence_phase(ds, n_chips)
+    assert 0.0 <= out["test_accuracy"] <= 1.0
+    assert out["target_accuracy"] == bench.TARGET_ACC
+    # 12 tiny steps will not reach 99%; the fields must say so honestly
+    if out["seconds_to_target"] is None:
+        assert out["steps_to_target"] is None
+
+
+def test_feeddict_baseline_runs(monkeypatch, ds):
+    monkeypatch.setattr(bench, "FEEDDICT_BATCH", 16)
+    monkeypatch.setattr(bench, "FEEDDICT_STEPS", 3)
+    rate = bench.feeddict_baseline_phase(ds, 8)
+    assert rate > 0 and np.isfinite(rate)
+
+
+def test_sync_every_matches_backend():
+    assert bench._sync_every(1) == 0
+    expected = 16 if jax.default_backend() == "cpu" else 0
+    assert bench._sync_every(8) == expected

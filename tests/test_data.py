@@ -87,6 +87,29 @@ def test_next_batch_covers_epoch_without_repeat():
     assert sorted(seen.tolist()) == list(range(8))
 
 
+def test_shuffle_stream_deterministic_per_seed():
+    """Epoch shuffles come from the native C++ permutation (NumPy fallback);
+    either way the index stream is a function of the DataSet seed."""
+    imgs = np.arange(32, dtype=np.float32).reshape(32, 1)
+    labels = np.arange(32) % 10
+
+    def stream(seed):
+        ds = DataSet(imgs, labels, one_hot=False, seed=seed)
+        return np.concatenate([ds.next_batch(8)[0].ravel() for _ in range(8)])
+
+    np.testing.assert_array_equal(stream(5), stream(5))
+    assert not np.array_equal(stream(5), stream(6))
+
+
+def test_shuffle_reshuffles_between_epochs():
+    imgs = np.arange(64, dtype=np.float32).reshape(64, 1)
+    ds = DataSet(imgs, np.zeros(64, dtype=np.int64), one_hot=False, seed=0)
+    epoch1 = np.concatenate([ds.next_batch(32)[0].ravel() for _ in range(2)])
+    epoch2 = np.concatenate([ds.next_batch(32)[0].ravel() for _ in range(2)])
+    assert sorted(epoch1.tolist()) == sorted(epoch2.tolist())
+    assert not np.array_equal(epoch1, epoch2)
+
+
 def test_shard_disjoint():
     imgs = np.arange(10, dtype=np.float32).reshape(10, 1)
     ds = DataSet(imgs, np.zeros(10, dtype=np.int64))

@@ -1,6 +1,8 @@
-"""Async PS emulation: sharding policy, protocol, stale-gradient semantics,
-multi-worker global-step termination — all in-process on localhost."""
+"""Async PS emulation: sharding policy, wire protocol, ps-side optimizers,
+stale-gradient semantics, multi-worker global-step termination, multi-chip
+worker compute — all in-process on localhost."""
 
+import socket
 import threading
 
 import jax
@@ -12,6 +14,8 @@ from distributed_tensorflow_tpu.models import DeepCNN
 from distributed_tensorflow_tpu.parallel.ps_emulation import (
     PSClient,
     PSServer,
+    _encode_msg,
+    _recv_msg,
     assign_shards,
     flatten_params,
     make_grad_fn,
@@ -48,29 +52,128 @@ def test_flatten_unflatten_roundtrip():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+# ---------------------------------------------------------------- protocol
+
+
+def test_wire_roundtrip_preserves_dtypes_shapes_and_meta():
+    """The transport is a typed frame (JSON header + raw tensor bytes) —
+    no object deserialization anywhere (the reference's gRPC/protobuf
+    transport likewise cannot execute code on receive)."""
+    msg = {
+        "op": "push_grads",
+        "count_step": True,
+        "grads": {
+            "a": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": np.array(7, dtype=np.int32),  # 0-d
+            "c": np.arange(4, dtype=np.float64),
+        },
+    }
+    a, b = socket.socketpair()
+    try:
+        a.sendall(_encode_msg(msg))
+        got = _recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+    assert got["op"] == "push_grads" and got["count_step"] is True
+    for k, v in msg["grads"].items():
+        assert got["grads"][k].dtype == v.dtype
+        assert got["grads"][k].shape == v.shape
+        np.testing.assert_array_equal(got["grads"][k], v)
+
+
+def test_encode_msg_contains_no_pickle_opcodes():
+    frame = _encode_msg({"op": "pull", "params": {"w": np.ones(3, np.float32)}})
+    # a pickle stream starts with PROTO (0x80); the frame is u64 | JSON | raw
+    assert frame[8:9] == b"{"
+    assert b"\x80\x04" not in frame[:64]
+
+
+def test_ping_carries_initialized_flag(ps_pair):
+    _, client = ps_pair
+    r = client.call(0, {"op": "ping"})
+    assert r["ok"] and r["initialized"] is False
+    flat = {"a": np.zeros(2, np.float32)}
+    client.init_params(flat, assign_shards(list(flat), 2))
+    assert client.call(0, {"op": "ping"})["initialized"] is True
+    # wait_initialized consumes the same lightweight status (no shard pull)
+    client.wait_initialized(poll_s=0.01)
+
+
 def test_pull_before_init_reports_uninitialized(ps_pair):
     _, client = ps_pair
     r = client.call(0, {"op": "pull"})
     assert r == {"ok": False, "uninitialized": True}
 
 
+# ------------------------------------------------------- ps-side optimizer
+
+
 def test_init_pull_push_cycle(ps_pair):
     _, client = ps_pair
     flat = {"a": np.ones(4, np.float32), "b": np.full(3, 2.0, np.float32)}
     assignment = assign_shards(list(flat), 2)
-    client.init_params(flat, assignment)
+    client.init_params(flat, assignment, optimizer="sgd", learning_rate=0.5)
     got, step = client.pull_all()
     assert step == 0
     np.testing.assert_allclose(got["a"], 1.0)
     np.testing.assert_allclose(got["b"], 2.0)
 
-    # SGD on the ps: p -= lr*g, global step counted once on ps0
+    # SGD applied ON the ps (ApplyGradientDescent parity, MNISTDist.py:149):
+    # p -= lr*g, global step counted once on ps0
     grads = {"a": np.ones(4, np.float32), "b": np.ones(3, np.float32)}
-    new_step = client.push_grads(grads, assignment, lr=0.5)
+    new_step = client.push_grads(grads, assignment)
     assert new_step == 1
     got, _ = client.pull_all()
     np.testing.assert_allclose(got["a"], 0.5)
     np.testing.assert_allclose(got["b"], 1.5)
+
+
+def test_unknown_optimizer_rejected_loudly(ps_pair):
+    """--mode=ps with an optimizer the ps cannot apply must fail at init,
+    not silently train with SGD."""
+    _, client = ps_pair
+    flat = {"a": np.zeros(2, np.float32)}
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        client.init_params(flat, assign_shards(list(flat), 2),
+                           optimizer="adagrad")
+
+
+@pytest.mark.parametrize("name", ["momentum", "adam"])
+def test_ps_optimizer_matches_device_optimizer(name, ps_pair):
+    """The host-side ps apply must track the in-jit optimizer exactly: run
+    the same grad sequence through both and compare trajectories."""
+    from distributed_tensorflow_tpu.training.train_state import (
+        apply_updates,
+        get_optimizer,
+    )
+
+    _, client = ps_pair
+    rng = np.random.default_rng(0)
+    flat = {
+        "a": rng.normal(size=(3, 2)).astype(np.float32),
+        "b": rng.normal(size=(4,)).astype(np.float32),
+    }
+    assignment = assign_shards(list(flat), 2)
+    client.init_params(flat, assignment, optimizer=name, learning_rate=0.1)
+
+    opt = get_optimizer(name, 0.1)
+    ref_params = {k: jnp.asarray(v) for k, v in flat.items()}
+    opt_state = opt.init(ref_params)
+
+    for i in range(5):
+        grads = {k: rng.normal(size=v.shape).astype(np.float32)
+                 for k, v in flat.items()}
+        client.push_grads(grads, assignment)
+        updates, opt_state = opt.update(
+            {k: jnp.asarray(g) for k, g in grads.items()}, opt_state, ref_params)
+        ref_params = apply_updates(ref_params, updates)
+
+    got, step = client.pull_all()
+    assert step == 5
+    for k in flat:
+        np.testing.assert_allclose(got[k], np.asarray(ref_params[k]),
+                                   rtol=1e-5, atol=1e-6)
 
 
 def test_global_step_counts_total_pushes_across_workers(ps_pair):
@@ -78,14 +181,14 @@ def test_global_step_counts_total_pushes_across_workers(ps_pair):
     servers, client = ps_pair
     flat = {"a": np.zeros(2, np.float32)}
     assignment = assign_shards(list(flat), 2)
-    client.init_params(flat, assignment)
+    client.init_params(flat, assignment, learning_rate=0.1)
 
     second = PSClient([s.address for s in servers])
     try:
         for _ in range(3):
-            client.push_grads({"a": np.ones(2, np.float32)}, assignment, lr=0.1)
+            client.push_grads({"a": np.ones(2, np.float32)}, assignment)
         for _ in range(2):
-            second.push_grads({"a": np.ones(2, np.float32)}, assignment, lr=0.1)
+            second.push_grads({"a": np.ones(2, np.float32)}, assignment)
         assert client.get_step() == 5
     finally:
         second.close()
@@ -96,14 +199,14 @@ def test_concurrent_pushes_are_all_applied(ps_pair):
     servers, client = ps_pair
     flat = {"a": np.zeros(1, np.float32)}
     assignment = assign_shards(list(flat), 2)
-    client.init_params(flat, assignment)
+    client.init_params(flat, assignment, optimizer="sgd", learning_rate=1.0)
 
     n_workers, n_pushes = 4, 25
     def worker():
         c = PSClient([s.address for s in servers])
         try:
             for _ in range(n_pushes):
-                c.push_grads({"a": np.full(1, -1.0, np.float32)}, assignment, lr=1.0)
+                c.push_grads({"a": np.full(1, -1.0, np.float32)}, assignment)
         finally:
             c.close()
 
@@ -117,6 +220,9 @@ def test_concurrent_pushes_are_all_applied(ps_pair):
     np.testing.assert_allclose(got["a"], n_workers * n_pushes)  # -= 1.0 * -1.0 each
 
 
+# ------------------------------------------------------- worker compute
+
+
 def test_grad_fn_end_to_end_with_ps(ps_pair):
     """A miniature async training loop drives the loss down."""
     _, client = ps_pair
@@ -124,9 +230,9 @@ def test_grad_fn_end_to_end_with_ps(ps_pair):
     params = model.init(jax.random.PRNGKey(0))
     flat = flatten_params(params)
     assignment = assign_shards(list(flat), 2)
-    client.init_params(flat, assignment)
+    client.init_params(flat, assignment, optimizer="sgd", learning_rate=0.05)
 
-    grad_fn = make_grad_fn(model, keep_prob=1.0)
+    grad_fn = make_grad_fn(model, keep_prob=1.0, devices=jax.devices()[:1])
     from distributed_tensorflow_tpu.data.synthetic import synthetic_digits
 
     xs, labels = synthetic_digits(16, seed=0)
@@ -140,8 +246,37 @@ def test_grad_fn_end_to_end_with_ps(ps_pair):
         rng, sub = jax.random.split(rng)
         grads, metrics = grad_fn(p, (x, y), sub)
         losses.append(float(metrics["loss"]))
-        client.push_grads(flatten_params(grads), assignment, lr=0.05)
+        client.push_grads(flatten_params(grads), assignment)
     assert min(losses[1:]) < losses[0], losses
+
+
+def test_multichip_worker_grads_match_single_chip():
+    """A worker host with N local chips shards the batch over a local mesh
+    and pmeans grads before the push (VERDICT r1 #10): the pushed grads must
+    equal the single-chip grads on the same batch. keep_prob=1 so the
+    per-shard dropout fold_in has no effect on the comparison."""
+    from distributed_tensorflow_tpu.data.synthetic import synthetic_digits
+
+    model = DeepCNN()
+    params = model.init(jax.random.PRNGKey(0))
+    xs, labels = synthetic_digits(32, seed=3)
+    x, y = jnp.asarray(xs), jax.nn.one_hot(jnp.asarray(labels), 10)
+    rng = jax.random.PRNGKey(7)
+
+    g1, m1 = make_grad_fn(model, 1.0, devices=jax.devices()[:1])(params, (x, y), rng)
+    g4, m4 = make_grad_fn(model, 1.0, devices=jax.devices()[:4])(params, (x, y), rng)
+
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(float(m1["loss"]), float(m4["loss"]), rtol=1e-5)
+
+
+def test_stateful_model_rejected_by_grad_fn():
+    from distributed_tensorflow_tpu.models import ResNet20
+
+    with pytest.raises(NotImplementedError, match="sync mode"):
+        make_grad_fn(ResNet20(), keep_prob=1.0)
 
 
 def test_shutdown_op(ps_pair):
