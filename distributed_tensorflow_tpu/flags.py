@@ -159,3 +159,12 @@ def define_reference_flags():
     DEFINE_boolean("raw_input", False, "Feed uint8 images + int32 labels and "
                    "normalize on device (4x less host->device traffic; "
                    "fastest path on bandwidth-limited links)")
+    DEFINE_boolean("device_data", False, "Stage the train split into HBM once "
+                   "and sample batches ON DEVICE inside the compiled step "
+                   "(zero host->device bytes per step; lax.scan runs "
+                   "--device_chunk steps per dispatch). Training batches are "
+                   "sampled with replacement rather than the reference's "
+                   "shuffled-epoch walk; display-step evals keep reference "
+                   "semantics (host-fed, dropout off)")
+    DEFINE_integer("device_chunk", 50, "Steps per compiled scan chunk in "
+                   "--device_data mode (clamped to divide display_step)")

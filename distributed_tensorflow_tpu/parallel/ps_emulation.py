@@ -34,6 +34,7 @@ owning ps shard).
 
 from __future__ import annotations
 
+import errno
 import json
 import socket
 import socketserver
@@ -167,11 +168,15 @@ class _PsOptimizer:
     (test_ps_optimizer_matches_device_optimizer) — change either side and
     that test fails."""
 
-    # the device-side registry is the source of truth for what exists
+    # advertise exactly what BOTH sides implement: the device registry
+    # gates what the CLI accepts, _APPLY gates what this host-side apply
+    # can do — an optimizer added to one but not the other is rejected
+    # loudly at init_shard instead of trained with the wrong math
     from distributed_tensorflow_tpu.training.train_state import (
         _OPTIMIZERS as _DEVICE_REGISTRY,
     )
-    NAMES = tuple(sorted(_DEVICE_REGISTRY))
+    _APPLY = ("sgd", "momentum", "adam")
+    NAMES = tuple(sorted(set(_DEVICE_REGISTRY) & set(_APPLY)))
 
     def __init__(self, name: str, lr: float):
         if name not in self.NAMES:
@@ -185,25 +190,27 @@ class _PsOptimizer:
         g = np.asarray(grad, dtype=np.float32)
         if self.name == "sgd":
             param -= self.lr * g
-            return
-        slots = self._slots.setdefault(key, {})
-        if self.name == "momentum":
+        elif self.name == "momentum":
+            slots = self._slots.setdefault(key, {})
             v = slots.setdefault("v", np.zeros_like(param))
             v *= 0.9
             v += g
             param -= self.lr * v
-            return
-        # adam (matches training.train_state.adam)
-        m = slots.setdefault("m", np.zeros_like(param))
-        v = slots.setdefault("v", np.zeros_like(param))
-        t = self._t.get(key, 0) + 1
-        self._t[key] = t
-        m *= 0.9
-        m += 0.1 * g
-        v *= 0.999
-        v += 0.001 * g * g
-        scale = self.lr * np.sqrt(1.0 - 0.999**t) / (1.0 - 0.9**t)
-        param -= scale * m / (np.sqrt(v) + 1e-8)
+        elif self.name == "adam":
+            # matches training.train_state.adam
+            slots = self._slots.setdefault(key, {})
+            m = slots.setdefault("m", np.zeros_like(param))
+            v = slots.setdefault("v", np.zeros_like(param))
+            t = self._t.get(key, 0) + 1
+            self._t[key] = t
+            m *= 0.9
+            m += 0.1 * g
+            v *= 0.999
+            v += 0.001 * g * g
+            scale = self.lr * np.sqrt(1.0 - 0.999**t) / (1.0 - 0.9**t)
+            param -= scale * m / (np.sqrt(v) + 1e-8)
+        else:  # unreachable through __init__'s NAMES gate
+            raise ValueError(f"_PsOptimizer cannot apply {self.name!r}")
 
 
 class PSServer:
@@ -223,7 +230,9 @@ class PSServer:
         self._shutdown = threading.Event()
         try:
             self._server = _ThreadedTCP((host, int(port)), _Handler)
-        except OSError:
+        except OSError as e:
+            if e.errno not in (errno.EADDRNOTAVAIL,):
+                raise  # EADDRINUSE/EACCES etc. are real config errors
             # the advertised name is not locally assignable (NAT / bridge /
             # load-balancer address): serve on all interfaces at the
             # advertised port instead — the reference's gRPC server behavior

@@ -1,0 +1,114 @@
+"""Chunked train steps over device-resident data (see data/device_data.py).
+
+Two builders mirroring the host-fed pair (``make_train_step`` /
+``make_dp_train_step``) but with the input side moved INSIDE the compiled
+program: each step draws its minibatch on device by PRNG gather from the
+resident split, and ``lax.scan`` runs ``chunk`` steps per dispatch so the
+host's per-step role shrinks to one function call per chunk. This is the
+TPU-native answer to the reference's per-step feed_dict upload
+(``MNISTDist.py:179,188``): nothing crosses the host boundary during
+training at all.
+
+Returned metrics are the LAST in-chunk step's training metrics (loss /
+accuracy of the train pass, dropout on). The host-fed loop's display
+semantics (dropout-off eval of the upcoming batch, ``MNISTDist.py:179-182``)
+need the batch on the host, so this fast mode trades that for speed —
+documented on the ``--device_data`` flag.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS
+from distributed_tensorflow_tpu.training.train_state import (
+    TrainState,
+    apply_updates,
+    loss_and_metrics,
+)
+
+_SAMPLE_SALT = 0x5EED  # folds the sampling stream away from the dropout stream
+
+
+def _sampled_step_body(model, optimizer, batch_size: int, keep_prob: float,
+                       axis: str | None):
+    """(state, data) -> (state, metrics): one full train step — on-device
+    batch sample, forward, backward, (pmean over ``axis`` if set), update.
+    ``state.rng`` advances every step, so the sampling key (a salted fold of
+    it) yields a fresh batch each iteration of a scan."""
+
+    def body(state: TrainState, data):
+        rng, sub = jax.random.split(state.rng)
+        samp = jax.random.fold_in(state.rng, _SAMPLE_SALT)
+        if axis is not None:
+            # distinct sample + dropout streams per data shard
+            samp = jax.random.fold_in(samp, lax.axis_index(axis))
+            sub = jax.random.fold_in(sub, lax.axis_index(axis))
+        idx = jax.random.randint(samp, (batch_size,), 0, data.num_examples)
+        batch = (data.images[idx], data.labels[idx])
+
+        def loss_fn(params):
+            return loss_and_metrics(model, params, batch, keep_prob=keep_prob,
+                                    rng=sub, train=True,
+                                    model_state=state.model_state)
+
+        grads, aux = jax.grad(loss_fn, has_aux=True)(state.params)
+        metrics, model_state = aux["metrics"], aux["model_state"]
+        if axis is not None:
+            grads = lax.pmean(grads, axis)
+            metrics = lax.pmean(metrics, axis)
+            if model_state:
+                model_state = lax.pmean(model_state, axis)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1, rng, model_state), metrics
+
+    return body
+
+
+def _scan_chunk(body, chunk: int):
+    def chunk_fn(state, data):
+        state, metrics = lax.scan(
+            lambda s, _: body(s, data), state, None, length=chunk
+        )
+        return state, jax.tree.map(lambda m: m[-1], metrics)
+
+    return chunk_fn
+
+
+def make_device_train_step(model, optimizer, batch_size: int, *,
+                           keep_prob: float = 1.0, chunk: int = 1,
+                           donate: bool = True):
+    """Single-device chunked step: (state, DeviceData) -> (state, metrics);
+    advances ``state.step`` by ``chunk``."""
+    body = _sampled_step_body(model, optimizer, batch_size, keep_prob, None)
+    fn = _scan_chunk(body, chunk)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def make_device_dp_train_step(model, optimizer, mesh, batch_size: int, *,
+                              keep_prob: float = 1.0, chunk: int = 1,
+                              donate: bool = True):
+    """Sync-DP chunked step over ``mesh``: state replicated, the resident
+    split replicated, each shard samples ``batch_size // n_data`` examples
+    locally and grads ``pmean`` over ICI — the input side costs no
+    collective at all."""
+    n_data = mesh.shape[DATA_AXIS]
+    if batch_size % n_data:
+        raise ValueError(
+            f"batch_size={batch_size} not divisible by the {n_data}-way "
+            f"data axis"
+        )
+    body = _sampled_step_body(model, optimizer, batch_size // n_data,
+                              keep_prob, DATA_AXIS)
+    fn = jax.shard_map(
+        _scan_chunk(body, chunk),
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())

@@ -95,6 +95,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     state = create_train_state(model, opt, seed=FLAGS.seed)
 
     n_chips = 1
+    mesh = None
     feed_batch = FLAGS.batch_size  # examples this process loads per step
     if mode == "sync":
         mesh = make_mesh()
@@ -113,6 +114,16 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         step_fn = make_train_step(model, opt, keep_prob=FLAGS.keep_prob)
         eval_fn = make_eval_step(model)
         stage = None  # prefetch default: device_put to the default device
+
+    use_device_data = bool(getattr(FLAGS, "device_data", False))
+    if use_device_data:
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "--device_data is single-process for now (the resident split "
+                "would need per-host placement); use the prefetch path"
+            )
+        return _train_device_resident(
+            FLAGS, ds, model, opt, state, mesh, n_chips, eval_fn, stage)
 
     sv = Supervisor(
         is_chief=(FLAGS.task_index == 0),
@@ -191,6 +202,124 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             if profiling:
                 jax.profiler.stop_trace()
             batches.close()
+
+    test_metrics = None
+    if FLAGS.test_eval:
+        test_metrics = evaluate(model, jax.device_get(state.params), ds.test,
+                                model_state=jax.device_get(state.model_state))
+        print("test accuracy: ", test_metrics["accuracy"],
+              "test loss: ", test_metrics["loss"])
+        logger.scalars(step, {"test_accuracy": test_metrics["accuracy"],
+                              "test_loss": test_metrics["loss"]})
+    print("Optimization Finished!")
+    logger.close()
+    return TrainResult(
+        final_step=step,
+        train_metrics=last_display,
+        test_metrics=test_metrics,
+        images_per_sec=meter.images_per_sec,
+        images_per_sec_per_chip=meter.images_per_sec_per_chip,
+        n_chips=n_chips,
+    )
+
+
+def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
+                           eval_fn, stage) -> TrainResult:
+    """--device_data training: the split resident in HBM, batches sampled on
+    device, ``lax.scan`` chunks amortizing dispatch (training/device_step).
+    Per training step NOTHING crosses the host boundary; per display step
+    one host batch is staged for the reference-semantics eval print
+    (dropout-off, before-the-update — ``MNISTDist.py:179-182``)."""
+    import math
+
+    from distributed_tensorflow_tpu.data.device_data import put_device_data
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_device_dp_train_step,
+        make_device_train_step,
+    )
+
+    data = put_device_data(ds.train, mesh)
+    chunk = max(1, math.gcd(FLAGS.display_step, max(1, FLAGS.device_chunk)))
+    if chunk != FLAGS.device_chunk:
+        print(f"--device_chunk={FLAGS.device_chunk} clamped to {chunk} so "
+              f"chunks land on --display_step={FLAGS.display_step} "
+              f"boundaries (dispatch amortization shrinks accordingly)")
+
+    def build_chunk_fn(length: int):
+        if mesh is not None:
+            return make_device_dp_train_step(
+                model, opt, mesh, FLAGS.batch_size,
+                keep_prob=FLAGS.keep_prob, chunk=length)
+        return make_device_train_step(
+            model, opt, FLAGS.batch_size,
+            keep_prob=FLAGS.keep_prob, chunk=length)
+
+    chunk_fns: dict[int, Any] = {}
+
+    def run_chunk(state, length: int):
+        fn = chunk_fns.get(length)
+        if fn is None:
+            fn = chunk_fns[length] = build_chunk_fn(length)
+        return fn(state, data)
+
+    sv = Supervisor(
+        is_chief=(FLAGS.task_index == 0),
+        logdir=FLAGS.logdir,
+        save_model_secs=FLAGS.save_model_secs,
+    )
+    logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
+                           job_name=FLAGS.job_name or "worker",
+                           task_index=FLAGS.task_index)
+    meter = Throughput(FLAGS.batch_size, n_chips)
+    last_display = {}
+    sync_every = collective_sync_cadence(mesh is not None)
+    chunks_done = 0
+
+    with sv.managed(state) as box:
+        state, step = box.state, box.step
+        compile_done = False
+        profiling = False
+        profile_done = not FLAGS.profile_dir
+        meter.reset()
+        while not sv.should_stop() and step < FLAGS.training_iter:
+            if step % FLAGS.display_step == 0:
+                # reference display semantics: dropout-off eval of a fresh
+                # minibatch before training continues (MNISTDist.py:179-182)
+                b = ds.train.next_batch(FLAGS.batch_size)
+                staged = stage(b) if stage is not None else jax.device_put(b)
+                m = eval_fn(state.params, staged, state.model_state)
+                last_display = {k: float(v) for k, v in m.items()}
+                logger.log_display(step, last_display["loss"],
+                                   last_display["accuracy"])
+                logger.scalars(step, {"images_per_sec": meter.images_per_sec})
+            if compile_done and not profile_done and not profiling:
+                jax.profiler.start_trace(FLAGS.profile_dir)
+                profiling = True
+                profile_stop_at = step + max(FLAGS.profile_steps, chunk)
+            # realign to display boundaries after a resume from an arbitrary
+            # checkpointed step, then cap at the remaining step budget
+            to_boundary = -step % FLAGS.display_step or chunk
+            length = min(chunk, to_boundary, FLAGS.training_iter - step)
+            state, train_m = run_chunk(state, length)
+            step += length
+            meter.step(length * FLAGS.batch_size)
+            chunks_done += 1
+            if sync_every and chunks_done % max(1, sync_every // chunk) == 0:
+                jax.block_until_ready(state.params)
+            if not compile_done:
+                jax.block_until_ready(state.params)
+                meter.reset()
+                compile_done = True
+            if profiling and step >= profile_stop_at:
+                jax.block_until_ready(state.params)
+                jax.profiler.stop_trace()
+                profiling = False
+                profile_done = True
+            box.update(state, step)
+            sv.maybe_checkpoint(state, step)
+        jax.block_until_ready(state.params)
+        if profiling:
+            jax.profiler.stop_trace()
 
     test_metrics = None
     if FLAGS.test_eval:

@@ -22,8 +22,17 @@ def ds(tmp_path_factory):
 @pytest.mark.parametrize("n_chips", [1, 8])
 def test_throughput_phase_runs(monkeypatch, ds, n_chips):
     monkeypatch.setattr(bench, "PER_CHIP_BATCH", 16)
-    monkeypatch.setattr(bench, "TIMED_STEPS", 4)
+    monkeypatch.setattr(bench, "WIRE_TIMED_STEPS", 4)
     rate = bench.throughput_phase(ds, n_chips)
+    assert rate > 0 and np.isfinite(rate)
+
+
+@pytest.mark.parametrize("n_chips", [1, 8])
+def test_device_resident_phase_runs(monkeypatch, ds, n_chips):
+    monkeypatch.setattr(bench, "PER_CHIP_BATCH", 16)
+    monkeypatch.setattr(bench, "CHUNK", 3)
+    monkeypatch.setattr(bench, "TIMED_CHUNKS", 2)
+    rate = bench.device_resident_phase(ds, n_chips)
     assert rate > 0 and np.isfinite(rate)
 
 

@@ -1,0 +1,244 @@
+"""Device-resident input: on-device batch sampling + scan-chunked steps
+(training/device_step.py, data/device_data.py) — the zero-host-bytes-per-
+step mode, single-device and over the 8-device virtual mesh, plus its
+--device_data integration into the training loop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data import read_data_sets
+from distributed_tensorflow_tpu.data.device_data import DeviceData, put_device_data
+from distributed_tensorflow_tpu.models import DeepCNN
+from distributed_tensorflow_tpu.training import adam, create_train_state, make_train_step
+from distributed_tensorflow_tpu.training.device_step import (
+    _SAMPLE_SALT,
+    make_device_dp_train_step,
+    make_device_train_step,
+)
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return read_data_sets("/nonexistent", one_hot=True)
+
+
+@pytest.fixture(scope="module")
+def data(ds):
+    return put_device_data(ds.train)
+
+
+def test_put_device_data_shapes_and_dtypes(ds, data):
+    assert data.images.dtype == jnp.uint8
+    assert data.labels.dtype == jnp.int32
+    assert data.num_examples == ds.train.num_examples
+    assert data.images.shape[0] == data.labels.shape[0]
+
+
+def test_chunk_advances_step_and_converges(data):
+    model = DeepCNN()
+    opt = adam(1e-3)
+    state = create_train_state(model, opt, seed=0)
+    step = make_device_train_step(model, opt, 64, keep_prob=0.75, chunk=5,
+                                  donate=False)
+    state, m0 = step(state, data)
+    assert int(state.step) == 5
+    for _ in range(7):
+        state, m = step(state, data)
+    assert int(state.step) == 40
+    assert float(m["loss"]) < float(m0["loss"])
+    assert np.isfinite(float(m["accuracy"]))
+
+
+def test_device_step_matches_host_step_on_same_batch(data):
+    """chunk=1 device-sampled step == make_train_step on the batch the
+    sampling PRNG selects: the input side moved into the program, the math
+    did not change."""
+    model = DeepCNN()
+    opt = adam(1e-3)
+    state = create_train_state(model, opt, seed=3)
+    dstep = make_device_train_step(model, opt, 32, keep_prob=0.75, chunk=1,
+                                   donate=False)
+    new_dev, m_dev = dstep(state, data)
+
+    # replicate the in-program sampling on the host
+    samp = jax.random.fold_in(state.rng, _SAMPLE_SALT)
+    idx = np.asarray(jax.random.randint(samp, (32,), 0, data.num_examples))
+    batch = (np.asarray(data.images)[idx], np.asarray(data.labels)[idx])
+    hstep = make_train_step(model, opt, keep_prob=0.75, donate=False)
+    new_host, m_host = hstep(state, batch)
+
+    np.testing.assert_allclose(float(m_dev["loss"]), float(m_host["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(new_dev.params),
+                    jax.tree.leaves(new_host.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_deterministic_per_seed(data):
+    model = DeepCNN()
+    opt = adam(1e-3)
+    step = make_device_train_step(model, opt, 32, keep_prob=0.75, chunk=4,
+                                  donate=False)
+
+    def run(seed):
+        state = create_train_state(model, opt, seed=seed)
+        for _ in range(3):
+            state, _ = step(state, data)
+        return np.asarray(state.params["weights"]["out"])
+
+    np.testing.assert_array_equal(run(1), run(1))
+    assert not np.array_equal(run(1), run(2))
+
+
+def test_dp_device_step_replicated_and_finite(ds):
+    from distributed_tensorflow_tpu.parallel import make_mesh
+    from distributed_tensorflow_tpu.parallel.data_parallel import replicate_state
+
+    mesh = make_mesh()
+    data = put_device_data(ds.train, mesh)
+    model = DeepCNN()
+    opt = adam(1e-3)
+    state = replicate_state(mesh, create_train_state(model, opt, seed=0))
+    step = make_device_dp_train_step(model, opt, mesh, 64, keep_prob=0.75,
+                                     chunk=3, donate=False)
+    state, m = step(state, data)
+    state, m = step(state, data)
+    assert int(state.step) == 6
+    assert np.isfinite(float(m["loss"]))
+    # replicated invariant: every device shard holds identical params
+    w = state.params["weights"]["out"]
+    shards = [np.asarray(s.data) for s in w.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+
+def test_dp_device_step_batch_divisibility():
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    with pytest.raises(ValueError, match="not divisible"):
+        make_device_dp_train_step(DeepCNN(), adam(1e-3), mesh, 30)
+
+
+# ------------------------------------------------------- loop integration
+
+
+def test_train_loop_device_data(tmp_path, capsys):
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    flags.FLAGS._reset()
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/logs",
+        f"--data_dir={tmp_path}/no-data",
+        "--training_iter=25",  # not a multiple of the chunk: remainder path
+        "--batch_size=32",
+        "--display_step=10",
+        "--optimizer=adam",
+        "--learning_rate=0.002",
+        "--save_model_secs=100000",
+        "--device_data",
+        "--device_chunk=10",
+    ])
+    try:
+        res = train(flags.FLAGS, mode="local")
+    finally:
+        flags.FLAGS._reset()
+    assert res.final_step == 25  # remainder chunk respected training_iter
+    assert res.test_metrics is not None
+    out = capsys.readouterr().out
+    assert "job: worker/0 step:  0 mini_batch loss:" in out
+    assert "Optimization Finished!" in out
+
+
+def test_train_loop_device_data_resume_realigns_display(tmp_path, capsys):
+    """Resuming from a step that is not a chunk multiple must realign to
+    display boundaries instead of silently never displaying again."""
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+
+    def run(training_iter):
+        flags.FLAGS._reset()
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/logs",
+            f"--data_dir={tmp_path}/no-data",
+            f"--training_iter={training_iter}",
+            "--batch_size=32",
+            "--display_step=10",
+            "--optimizer=adam",
+            "--save_model_secs=100000",
+            "--device_data",
+            "--device_chunk=10",
+        ])
+        try:
+            return train(flags.FLAGS, mode="local")
+        finally:
+            flags.FLAGS._reset()
+
+    run(13)  # final checkpoint lands at the misaligned step 13
+    capsys.readouterr()
+    res = run(25)  # resumes at 13 -> chunks 7 (realign), 10, 5
+    assert res.final_step == 25
+    out = capsys.readouterr().out
+    assert "step:  20 mini_batch loss:" in out
+
+
+def test_train_loop_device_data_profile_dir(tmp_path):
+    import glob
+
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    flags.FLAGS._reset()
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/logs",
+        f"--data_dir={tmp_path}/no-data",
+        "--training_iter=20",
+        "--batch_size=32",
+        "--display_step=10",
+        "--save_model_secs=100000",
+        "--device_data",
+        "--device_chunk=5",
+        f"--profile_dir={tmp_path}/prof",
+        "--profile_steps=5",
+    ])
+    try:
+        train(flags.FLAGS, mode="local")
+    finally:
+        flags.FLAGS._reset()
+    assert glob.glob(f"{tmp_path}/prof/**/*.trace*", recursive=True) or \
+        glob.glob(f"{tmp_path}/prof/**/*.pb", recursive=True), \
+        "no profiler trace written"
+
+
+def test_train_loop_device_data_sync(tmp_path):
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    flags.FLAGS._reset()
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/logs",
+        f"--data_dir={tmp_path}/no-data",
+        "--training_iter=20",
+        "--batch_size=32",
+        "--display_step=10",
+        "--optimizer=adam",
+        "--save_model_secs=100000",
+        "--device_data",
+        "--device_chunk=10",
+    ])
+    try:
+        res = train(flags.FLAGS, mode="sync")
+    finally:
+        flags.FLAGS._reset()
+    assert res.final_step == 20
+    assert res.n_chips == 8
+    assert res.test_metrics is not None
