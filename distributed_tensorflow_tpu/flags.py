@@ -168,3 +168,7 @@ def define_reference_flags():
                    "semantics (host-fed, dropout off)")
     DEFINE_integer("device_chunk", 50, "Steps per compiled scan chunk in "
                    "--device_data mode (clamped to divide display_step)")
+    DEFINE_integer("model_axis", 1, "Tensor-parallel ways on the mesh's "
+                   "'model' axis (sync mode): the CNN's FC stack is "
+                   "column/row-split and XLA inserts the collectives. "
+                   "1 = pure data parallelism (reference-equivalent)")

@@ -8,6 +8,10 @@ from distributed_tensorflow_tpu.parallel.data_parallel import (
     make_dp_train_step,
     shard_batch,
 )
+from distributed_tensorflow_tpu.parallel.tensor_parallel import (
+    make_tp_train_step,
+    shard_state_tp,
+)
 
 __all__ = [
     "MeshSpec",
@@ -16,4 +20,6 @@ __all__ = [
     "replicated_sharding",
     "make_dp_train_step",
     "shard_batch",
+    "make_tp_train_step",
+    "shard_state_tp",
 ]

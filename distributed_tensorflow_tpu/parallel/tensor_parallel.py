@@ -1,0 +1,169 @@
+"""Tensor parallelism over the mesh's "model" axis — GSPMD style.
+
+The reference has no model parallelism of any kind (SURVEY.md §2c: its only
+strategy is async PS data-parallelism, ``MNISTDist.py:110-111``); the mesh
+keeps a "model" axis open precisely so wider models can shard without
+reshaping the framework (parallel/mesh.py). This module makes that axis
+real for the flagship CNN: the classic column/row split of the FC stack —
+
+    wd1 [3136, 1024]  column-split  P(None, "model")   (bd1 follows)
+    out [1024,   10]  row-split     P("model", None)
+
+so the big matmul's output activations are sharded over "model", the
+second matmul contracts over the sharded dimension, and XLA's SPMD
+partitioner inserts the one ``psum`` the math needs. No manual collective
+appears in this file: shardings are ANNOTATED on the arrays
+(``NamedSharding``) and the step is a plain global-view ``jax.jit`` —
+the "pick a mesh, annotate, let XLA insert collectives" recipe. Composes
+with data parallelism on the same mesh: batch dims carry P("data").
+
+Conv kernels and small biases stay replicated (their FLOPs don't pay for
+collective traffic at these shapes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from distributed_tensorflow_tpu.training.train_state import (
+    TrainState,
+    apply_updates,
+    loss_and_metrics,
+)
+
+# FC-stack split for the reference CNN's parameter names (models/cnn.py):
+# first FC column-parallel, second FC row-parallel.
+_CNN_TP_SPECS = {
+    ("weights", "wd1"): P(None, MODEL_AXIS),
+    ("biases", "bd1"): P(MODEL_AXIS),
+    ("weights", "out"): P(MODEL_AXIS, None),
+}
+
+
+def tp_param_specs(params) -> dict:
+    """PartitionSpec pytree mirroring ``params``: FC stack split over the
+    model axis, everything else replicated."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    specs = {}
+    for path, _ in flat:
+        keys = tuple(getattr(p, "key", getattr(p, "idx", None)) for p in path)
+        specs[keys] = _CNN_TP_SPECS.get(keys, P())
+    # rebuild the nested dict shape
+    out: dict = {}
+    for keys, spec in specs.items():
+        node = out
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = spec
+    return out
+
+
+def has_tp_specs(params) -> bool:
+    """True when at least one leaf of ``params`` has a model-axis split —
+    i.e. tensor parallelism would actually shard something. Models without
+    matching names (e.g. the ResNets) would silently replicate everything
+    over the model axis; callers use this to reject that loudly."""
+    specs = tp_param_specs(params)
+    return any(s != P() for s in
+               jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)))
+
+
+def _map_specs(tree, specs_like, mesh):
+    """NamedShardings for ``tree`` using a params-shaped spec tree."""
+    leaves_specs = jax.tree.leaves(specs_like,
+                                   is_leaf=lambda x: isinstance(x, P))
+    structure = jax.tree.structure(tree)
+    assert structure.num_leaves == len(leaves_specs), (
+        "opt-state subtree does not mirror params"
+    )
+    return jax.tree.unflatten(
+        structure, [NamedSharding(mesh, s) for s in leaves_specs]
+    )
+
+
+def tp_state_sharding(state: TrainState, mesh: Mesh) -> TrainState:
+    """Sharding pytree matching ``state``: params (and their optimizer
+    slots) follow ``tp_param_specs``; scalars and rng replicated."""
+    pspecs = tp_param_specs(state.params)
+    rep = NamedSharding(mesh, P())
+    params_sh = _map_specs(state.params, pspecs, mesh)
+
+    opt = state.opt_state
+    if opt == ():
+        opt_sh: object = ()
+    elif isinstance(opt, dict) and "m" in opt and "v" in opt:  # adam
+        opt_sh = {
+            "m": _map_specs(opt["m"], pspecs, mesh),
+            "v": _map_specs(opt["v"], pspecs, mesh),
+            "t": rep,
+        }
+    else:  # momentum: a params-shaped velocity tree
+        opt_sh = _map_specs(opt, pspecs, mesh)
+
+    model_state_sh = jax.tree.map(lambda _: rep, state.model_state)
+    return TrainState(params=params_sh, opt_state=opt_sh, step=rep, rng=rep,
+                      model_state=model_state_sh)
+
+
+def shard_state_tp(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place a host-built TrainState with the TP layout."""
+    return jax.device_put(state, tp_state_sharding(state, mesh))
+
+
+def make_tp_train_step(model, optimizer, mesh: Mesh, keep_prob: float = 1.0,
+                       donate: bool = True):
+    """Compiled TP(+DP) train step: (state, batch) -> (state, metrics).
+
+    Global-view program: the batch arrives sharded P("data") and params
+    carry their TP shardings; XLA's SPMD partitioner derives every
+    collective (grad psum over "data", activation psum over "model"). The
+    body is the same math as ``make_train_step`` — only the array layouts
+    changed, which is the point of the GSPMD design.
+    """
+    def step_fn(state: TrainState, batch):
+        rng, sub = jax.random.split(state.rng)
+
+        def loss_fn(params):
+            return loss_and_metrics(model, params, batch,
+                                    keep_prob=keep_prob, rng=sub, train=True,
+                                    model_state=state.model_state)
+
+        grads, aux = jax.grad(loss_fn, has_aux=True)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = apply_updates(state.params, updates)
+        return (
+            TrainState(params, opt_state, state.step + 1, rng,
+                       aux["model_state"]),
+            aux["metrics"],
+        )
+
+    if donate:
+        return jax.jit(step_fn, donate_argnums=(0,))
+    return jax.jit(step_fn)
+
+
+def make_tp_eval_step(model):
+    """Global-view eval: shardings propagate from the committed params."""
+
+    @jax.jit
+    def eval_fn(params, batch, model_state=()):
+        _, aux = loss_and_metrics(model, params, batch, train=False,
+                                  model_state=model_state)
+        return aux["metrics"]
+
+    return eval_fn
+
+
+def stage_batch_tp(mesh: Mesh, batch):
+    """Batch staged with data-axis sharding (model axis untouched).
+
+    Delegates to ``shard_batch``: identical layout, and its multi-process
+    branch (per-host slices assembled via
+    ``make_array_from_process_local_data``) applies unchanged to TP+DP."""
+    from distributed_tensorflow_tpu.parallel.data_parallel import shard_batch
+
+    return shard_batch(mesh, batch)

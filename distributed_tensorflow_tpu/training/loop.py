@@ -96,8 +96,50 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
 
     n_chips = 1
     mesh = None
+    restage = None  # re-place a host-restored state onto the mesh layout
     feed_batch = FLAGS.batch_size  # examples this process loads per step
-    if mode == "sync":
+    model_axis = max(1, getattr(FLAGS, "model_axis", 1))
+    if mode == "sync" and model_axis > 1:
+        # tensor parallelism (+DP on the remaining devices): GSPMD layout,
+        # XLA inserts the collectives — parallel/tensor_parallel.py
+        from distributed_tensorflow_tpu.parallel import MeshSpec
+        from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS
+        from distributed_tensorflow_tpu.parallel.tensor_parallel import (
+            has_tp_specs,
+            make_tp_eval_step,
+            make_tp_train_step,
+            shard_state_tp,
+            stage_batch_tp,
+            tp_state_sharding,
+        )
+
+        if getattr(FLAGS, "device_data", False):
+            raise NotImplementedError(
+                "--device_data composes with data parallelism only; drop "
+                "--model_axis or --device_data"
+            )
+        if not has_tp_specs(state.params):
+            raise ValueError(
+                f"--model_axis={model_axis} but model {FLAGS.model!r} has no "
+                f"tensor-parallel sharding rule — every parameter would "
+                f"replicate and the extra devices would do redundant work. "
+                f"Use --model_axis=1 (data parallelism) for this model."
+            )
+        mesh = make_mesh(MeshSpec(data=-1, model=model_axis))
+        n_chips = mesh.devices.size
+        data_ways = mesh.shape[DATA_AXIS]
+        if FLAGS.batch_size % data_ways:
+            raise ValueError(
+                f"--batch_size={FLAGS.batch_size} must be divisible by the "
+                f"{data_ways}-way data axis"
+            )
+        feed_batch = local_batch_size(FLAGS.batch_size)
+        state = shard_state_tp(state, mesh)
+        step_fn = make_tp_train_step(model, opt, mesh, keep_prob=FLAGS.keep_prob)
+        eval_fn = make_tp_eval_step(model)
+        stage = lambda b: stage_batch_tp(mesh, b)
+        restage = lambda s: jax.device_put(s, tp_state_sharding(s, mesh))
+    elif mode == "sync":
         mesh = make_mesh()
         n_chips = mesh.devices.size
         if FLAGS.batch_size % n_chips:
@@ -153,6 +195,10 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
 
     with sv.managed(state) as box:
         state, step = box.state, box.step
+        if restage is not None:
+            # a restored checkpoint arrives as host arrays; re-place it on
+            # the mesh layout (no-op when the state is already placed)
+            state = restage(state)
         # background host->device staging; the accelerator never waits on
         # next_batch (the feed-dict bottleneck this build eliminates,
         # SURVEY.md §3.4)

@@ -1,0 +1,189 @@
+"""Tensor parallelism over the "model" mesh axis: spec rules, placement,
+and exact equivalence of the TP(+DP) global-view step with the
+single-device step — the sharding changed, the math must not."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.data.synthetic import synthetic_digits
+from distributed_tensorflow_tpu.models import DeepCNN
+from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
+from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from distributed_tensorflow_tpu.parallel.tensor_parallel import (
+    make_tp_eval_step,
+    make_tp_train_step,
+    shard_state_tp,
+    stage_batch_tp,
+    tp_param_specs,
+)
+from distributed_tensorflow_tpu.training import (
+    adam,
+    create_train_state,
+    make_train_step,
+    sgd,
+)
+
+
+def _batch(n=32, seed=0):
+    xs, labels = synthetic_digits(n, seed=seed)
+    return jnp.asarray(xs), jax.nn.one_hot(jnp.asarray(labels), 10)
+
+
+def test_tp_param_specs_rules():
+    model = DeepCNN()
+    params = model.init(jax.random.PRNGKey(0))
+    specs = tp_param_specs(params)
+    assert specs["weights"]["wd1"] == P(None, MODEL_AXIS)
+    assert specs["biases"]["bd1"] == P(MODEL_AXIS)
+    assert specs["weights"]["out"] == P(MODEL_AXIS, None)
+    assert specs["weights"]["wc1"] == P()
+    assert specs["biases"]["out"] == P()
+
+
+@pytest.mark.parametrize("data,model_par", [(4, 2), (2, 4), (1, 8)])
+def test_tp_placement_shards_fc_stack(data, model_par):
+    mesh = make_mesh(MeshSpec(data=data, model=model_par))
+    model = DeepCNN()
+    state = shard_state_tp(create_train_state(model, adam(1e-3), seed=0), mesh)
+    wd1 = state.params["weights"]["wd1"]
+    # column split: each device holds 1024/model_par columns
+    assert wd1.addressable_shards[0].data.shape == (3136, 1024 // model_par)
+    out = state.params["weights"]["out"]
+    assert out.addressable_shards[0].data.shape == (1024 // model_par, 10)
+    # conv kernels replicated
+    wc1 = state.params["weights"]["wc1"]
+    assert wc1.addressable_shards[0].data.shape == wc1.shape
+    # adam slots follow their params
+    m_wd1 = state.opt_state["m"]["weights"]["wd1"]
+    assert m_wd1.addressable_shards[0].data.shape == (3136, 1024 // model_par)
+
+
+@pytest.mark.parametrize("data,model_par", [(4, 2), (2, 4)])
+def test_tp_step_equals_single_device_step(data, model_par):
+    """One TP(+DP) global-view step == one single-device step, same batch,
+    same init (keep_prob=1 so dropout cannot differ)."""
+    mesh = make_mesh(MeshSpec(data=data, model=model_par))
+    model = DeepCNN()
+    opt = sgd(0.05)
+    batch = _batch(32)
+
+    ref_state = create_train_state(model, opt, seed=0)
+    ref_step = make_train_step(model, opt, keep_prob=1.0, donate=False)
+    ref_after, ref_m = ref_step(ref_state, batch)
+
+    tp_state = shard_state_tp(create_train_state(model, opt, seed=0), mesh)
+    tp_step = make_tp_train_step(model, opt, mesh, keep_prob=1.0, donate=False)
+    tp_after, tp_m = tp_step(tp_state, stage_batch_tp(mesh, batch))
+
+    np.testing.assert_allclose(float(tp_m["loss"]), float(ref_m["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(ref_after.params),
+                    jax.tree.leaves(tp_after.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_tp_sharding_preserved_across_steps():
+    """Donated multi-step training keeps the TP layout (no silent
+    re-replication by XLA's sharding propagation)."""
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    model = DeepCNN()
+    opt = adam(1e-3)
+    state = shard_state_tp(create_train_state(model, opt, seed=0), mesh)
+    step = make_tp_train_step(model, opt, mesh, keep_prob=0.75, donate=False)
+    batch = stage_batch_tp(mesh, _batch(16))
+    losses = []
+    for _ in range(4):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    wd1 = state.params["weights"]["wd1"]
+    assert wd1.addressable_shards[0].data.shape == (3136, 256)
+    assert losses[-1] < losses[0]
+
+
+def test_tp_eval_step():
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    model = DeepCNN()
+    state = shard_state_tp(create_train_state(model, sgd(0.01), seed=0), mesh)
+    eval_fn = make_tp_eval_step(model)
+    m = eval_fn(state.params, stage_batch_tp(mesh, _batch(24)), ())
+    assert np.isfinite(float(m["loss"]))
+    assert 0.0 <= float(m["accuracy"]) <= 1.0
+
+
+def test_train_loop_model_axis(tmp_path, capsys):
+    """--model_axis=2 end-to-end through train(): TP+DP over the 8-device
+    mesh, reference stdout format, checkpoint + resume restage."""
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+
+    def run(training_iter):
+        flags.FLAGS._reset()
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/logs",
+            f"--data_dir={tmp_path}/no-data",
+            f"--training_iter={training_iter}",
+            "--batch_size=32",
+            "--display_step=10",
+            "--optimizer=adam",
+            "--save_model_secs=100000",
+            "--model_axis=2",
+        ])
+        try:
+            return train(flags.FLAGS, mode="sync")
+        finally:
+            flags.FLAGS._reset()
+
+    res = run(20)
+    assert res.final_step == 20
+    assert res.n_chips == 8
+    assert res.test_metrics is not None
+    out = capsys.readouterr().out
+    assert "job: worker/0 step:  0 mini_batch loss:" in out
+    # resume path: restored host state is re-placed onto the TP layout
+    res2 = run(30)
+    assert res2.final_step == 30
+
+
+def test_model_axis_rejects_model_without_tp_rule(tmp_path):
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    flags.FLAGS._reset()
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/logs",
+        f"--data_dir={tmp_path}/no-data",
+        "--model=resnet20",
+        "--dataset=cifar10",
+        "--model_axis=2",
+    ])
+    try:
+        with pytest.raises(ValueError, match="no.*tensor-parallel"):
+            train(flags.FLAGS, mode="sync")
+    finally:
+        flags.FLAGS._reset()
+
+
+def test_model_axis_rejects_device_data(tmp_path):
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    flags.FLAGS._reset()
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/logs",
+        f"--data_dir={tmp_path}/no-data",
+        "--model_axis=2",
+        "--device_data",
+    ])
+    try:
+        with pytest.raises(NotImplementedError, match="device_data"):
+            train(flags.FLAGS, mode="sync")
+    finally:
+        flags.FLAGS._reset()
