@@ -168,6 +168,10 @@ def define_reference_flags():
                    "semantics (host-fed, dropout off)")
     DEFINE_integer("device_chunk", 50, "Steps per compiled scan chunk in "
                    "--device_data mode (clamped to divide display_step)")
+    DEFINE_float("clip_norm", 0.0, "If > 0, clip gradients to this global "
+                 "L2 norm before the optimizer update (local/sync/TP/"
+                 "device_data modes; ps mode keeps reference parity). "
+                 "Guards against early loss spikes at high learning rates")
     DEFINE_integer("model_axis", 1, "Tensor-parallel ways on the mesh's "
                    "'model' axis (sync mode): the CNN's FC stack is "
                    "column/row-split and XLA inserts the collectives. "

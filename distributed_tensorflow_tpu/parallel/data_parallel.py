@@ -73,14 +73,16 @@ def local_batch_size(global_batch_size: int) -> int:
     return global_batch_size // n
 
 
-def make_dp_train_step(model, optimizer, mesh, keep_prob: float = 1.0, donate: bool = True):
+def make_dp_train_step(model, optimizer, mesh, keep_prob: float = 1.0, donate: bool = True,
+                       grad_transform=None):
     """Compiled sync-DP train step: (state, sharded batch) -> (state, metrics).
 
     Per-shard: forward+backward on the local batch slice with a
     device-distinct dropout rng; then ``pmean`` of grads *and* metrics over
     the data axis; then an identical optimizer update on every device, so
     replicated state stays bitwise in sync (the property the reference
-    gives up by going async).
+    gives up by going async). ``grad_transform`` (e.g. global-norm clip)
+    runs on the aggregated grads, identically on every shard.
     """
 
     def per_shard(state: TrainState, batch):
@@ -96,6 +98,8 @@ def make_dp_train_step(model, optimizer, mesh, keep_prob: float = 1.0, donate: b
 
         grads, aux = jax.grad(loss_fn, has_aux=True)(state.params)
         grads = lax.pmean(grads, DATA_AXIS)
+        if grad_transform is not None:
+            grads = grad_transform(grads)
         metrics = lax.pmean(aux["metrics"], DATA_AXIS)
         # cross-replica batch-norm stats: average the per-shard EMAs so the
         # replicated state stays identical on every device

@@ -24,15 +24,10 @@ collective traffic at these shapes).
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
-from distributed_tensorflow_tpu.training.train_state import (
-    TrainState,
-    apply_updates,
-    loss_and_metrics,
-)
+from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
+from distributed_tensorflow_tpu.training.train_state import TrainState
 
 # FC-stack split for the reference CNN's parameter names (models/cnn.py):
 # first FC column-parallel, second FC row-parallel.
@@ -114,48 +109,30 @@ def shard_state_tp(state: TrainState, mesh: Mesh) -> TrainState:
 
 
 def make_tp_train_step(model, optimizer, mesh: Mesh, keep_prob: float = 1.0,
-                       donate: bool = True):
+                       donate: bool = True, grad_transform=None):
     """Compiled TP(+DP) train step: (state, batch) -> (state, metrics).
 
-    Global-view program: the batch arrives sharded P("data") and params
-    carry their TP shardings; XLA's SPMD partitioner derives every
-    collective (grad psum over "data", activation psum over "model"). The
-    body is the same math as ``make_train_step`` — only the array layouts
-    changed, which is the point of the GSPMD design.
+    This IS ``make_train_step``: under GSPMD the program is global-view and
+    parallelism comes entirely from the layouts committed on the input
+    arrays (``shard_state_tp`` / ``stage_batch_tp``) — XLA's SPMD
+    partitioner derives every collective (grad psum over "data", activation
+    psum over "model") from those. ``mesh`` is accepted for API symmetry
+    with ``make_dp_train_step`` and to document which mesh the caller
+    placed the state on; the compiled code never reads it.
     """
-    def step_fn(state: TrainState, batch):
-        rng, sub = jax.random.split(state.rng)
+    del mesh
+    from distributed_tensorflow_tpu.training.train_state import make_train_step
 
-        def loss_fn(params):
-            return loss_and_metrics(model, params, batch,
-                                    keep_prob=keep_prob, rng=sub, train=True,
-                                    model_state=state.model_state)
-
-        grads, aux = jax.grad(loss_fn, has_aux=True)(state.params)
-        updates, opt_state = optimizer.update(grads, state.opt_state,
-                                              state.params)
-        params = apply_updates(state.params, updates)
-        return (
-            TrainState(params, opt_state, state.step + 1, rng,
-                       aux["model_state"]),
-            aux["metrics"],
-        )
-
-    if donate:
-        return jax.jit(step_fn, donate_argnums=(0,))
-    return jax.jit(step_fn)
+    return make_train_step(model, optimizer, keep_prob=keep_prob,
+                           grad_transform=grad_transform, donate=donate)
 
 
 def make_tp_eval_step(model):
-    """Global-view eval: shardings propagate from the committed params."""
+    """Global-view eval: shardings propagate from the committed params —
+    the plain eval step unchanged."""
+    from distributed_tensorflow_tpu.training.train_state import make_eval_step
 
-    @jax.jit
-    def eval_fn(params, batch, model_state=()):
-        _, aux = loss_and_metrics(model, params, batch, train=False,
-                                  model_state=model_state)
-        return aux["metrics"]
-
-    return eval_fn
+    return make_eval_step(model)
 
 
 def stage_batch_tp(mesh: Mesh, batch):

@@ -34,7 +34,7 @@ _SAMPLE_SALT = 0x5EED  # folds the sampling stream away from the dropout stream
 
 
 def _sampled_step_body(model, optimizer, batch_size: int, keep_prob: float,
-                       axis: str | None):
+                       axis: str | None, grad_transform=None):
     """(state, data) -> (state, metrics): one full train step — on-device
     batch sample, forward, backward, (pmean over ``axis`` if set), update.
     ``state.rng`` advances every step, so the sampling key (a salted fold of
@@ -62,6 +62,8 @@ def _sampled_step_body(model, optimizer, batch_size: int, keep_prob: float,
             metrics = lax.pmean(metrics, axis)
             if model_state:
                 model_state = lax.pmean(model_state, axis)
+        if grad_transform is not None:
+            grads = grad_transform(grads)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1, rng, model_state), metrics
@@ -81,17 +83,18 @@ def _scan_chunk(body, chunk: int):
 
 def make_device_train_step(model, optimizer, batch_size: int, *,
                            keep_prob: float = 1.0, chunk: int = 1,
-                           donate: bool = True):
+                           donate: bool = True, grad_transform=None):
     """Single-device chunked step: (state, DeviceData) -> (state, metrics);
     advances ``state.step`` by ``chunk``."""
-    body = _sampled_step_body(model, optimizer, batch_size, keep_prob, None)
+    body = _sampled_step_body(model, optimizer, batch_size, keep_prob, None,
+                              grad_transform)
     fn = _scan_chunk(body, chunk)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 def make_device_dp_train_step(model, optimizer, mesh, batch_size: int, *,
                               keep_prob: float = 1.0, chunk: int = 1,
-                              donate: bool = True):
+                              donate: bool = True, grad_transform=None):
     """Sync-DP chunked step over ``mesh``: state replicated, the resident
     split replicated, each shard samples ``batch_size // n_data`` examples
     locally and grads ``pmean`` over ICI — the input side costs no
@@ -103,7 +106,7 @@ def make_device_dp_train_step(model, optimizer, mesh, batch_size: int, *,
             f"data axis"
         )
     body = _sampled_step_body(model, optimizer, batch_size // n_data,
-                              keep_prob, DATA_AXIS)
+                              keep_prob, DATA_AXIS, grad_transform)
     fn = jax.shard_map(
         _scan_chunk(body, chunk),
         mesh=mesh,

@@ -99,6 +99,18 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     restage = None  # re-place a host-restored state onto the mesh layout
     feed_batch = FLAGS.batch_size  # examples this process loads per step
     model_axis = max(1, getattr(FLAGS, "model_axis", 1))
+    if model_axis > 1 and mode != "sync":
+        raise ValueError(
+            f"--model_axis={model_axis} requires sync mode (a device mesh); "
+            f"got mode={mode!r}. Use --mode=sync."
+        )
+    clip = None
+    if getattr(FLAGS, "clip_norm", 0.0) > 0:
+        from distributed_tensorflow_tpu.training.train_state import (
+            clip_by_global_norm,
+        )
+
+        clip = clip_by_global_norm(FLAGS.clip_norm)
     if mode == "sync" and model_axis > 1:
         # tensor parallelism (+DP on the remaining devices): GSPMD layout,
         # XLA inserts the collectives — parallel/tensor_parallel.py
@@ -135,7 +147,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             )
         feed_batch = local_batch_size(FLAGS.batch_size)
         state = shard_state_tp(state, mesh)
-        step_fn = make_tp_train_step(model, opt, mesh, keep_prob=FLAGS.keep_prob)
+        step_fn = make_tp_train_step(model, opt, mesh, keep_prob=FLAGS.keep_prob,
+                                     grad_transform=clip)
         eval_fn = make_tp_eval_step(model)
         stage = lambda b: stage_batch_tp(mesh, b)
         restage = lambda s: jax.device_put(s, tp_state_sharding(s, mesh))
@@ -149,11 +162,13 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             )
         feed_batch = local_batch_size(FLAGS.batch_size)
         state = replicate_state(mesh, state)
-        step_fn = make_dp_train_step(model, opt, mesh, keep_prob=FLAGS.keep_prob)
+        step_fn = make_dp_train_step(model, opt, mesh, keep_prob=FLAGS.keep_prob,
+                                     grad_transform=clip)
         eval_fn = make_dp_eval_step(model, mesh)
         stage = lambda b: shard_batch(mesh, b)
     else:
-        step_fn = make_train_step(model, opt, keep_prob=FLAGS.keep_prob)
+        step_fn = make_train_step(model, opt, keep_prob=FLAGS.keep_prob,
+                                  grad_transform=clip)
         eval_fn = make_eval_step(model)
         stage = None  # prefetch default: device_put to the default device
 
@@ -165,7 +180,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 "would need per-host placement); use the prefetch path"
             )
         return _train_device_resident(
-            FLAGS, ds, model, opt, state, mesh, n_chips, eval_fn, stage)
+            FLAGS, ds, model, opt, state, mesh, n_chips, eval_fn, stage, clip)
 
     sv = Supervisor(
         is_chief=(FLAGS.task_index == 0),
@@ -270,7 +285,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
 
 
 def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
-                           eval_fn, stage) -> TrainResult:
+                           eval_fn, stage, grad_transform=None) -> TrainResult:
     """--device_data training: the split resident in HBM, batches sampled on
     device, ``lax.scan`` chunks amortizing dispatch (training/device_step).
     Per training step NOTHING crosses the host boundary; per display step
@@ -295,10 +310,12 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
         if mesh is not None:
             return make_device_dp_train_step(
                 model, opt, mesh, FLAGS.batch_size,
-                keep_prob=FLAGS.keep_prob, chunk=length)
+                keep_prob=FLAGS.keep_prob, chunk=length,
+                grad_transform=grad_transform)
         return make_device_train_step(
             model, opt, FLAGS.batch_size,
-            keep_prob=FLAGS.keep_prob, chunk=length)
+            keep_prob=FLAGS.keep_prob, chunk=length,
+            grad_transform=grad_transform)
 
     chunk_fns: dict[int, Any] = {}
 

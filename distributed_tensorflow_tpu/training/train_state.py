@@ -99,6 +99,28 @@ def apply_updates(params, updates):
     return jax.tree.map(lambda p, u: p + u.astype(p.dtype), params, updates)
 
 
+def clip_by_global_norm(max_norm: float):
+    """Gradient transform: scale the whole grad pytree so its global L2
+    norm is at most ``max_norm`` (the classic tf.clip_by_global_norm).
+
+    Not in the reference (vanilla SGD, MNISTDist.py:149), but the flagship
+    CNN's first steps can spike (observed: loss 6 -> 86 in one adam step at
+    lr 1e-2, frying the ReLUs into a dead plateau); one clip makes every
+    optimizer robust to that. Composes with DP/TP: it runs on the
+    already-aggregated grads, and under GSPMD the norm reduction is
+    partitioned by XLA like any other reduction."""
+    max_norm = float(max_norm)
+
+    def transform(grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in jax.tree.leaves(grads))
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+        return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+
+    return transform
+
+
 def create_train_state(model, optimizer: Optimizer, seed: int = 0) -> TrainState:
     # old-style raw uint32 keys: a plain array, so the whole TrainState
     # (rng included) serializes through the numpy checkpoint path

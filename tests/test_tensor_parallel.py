@@ -93,7 +93,9 @@ def test_tp_sharding_preserved_across_steps():
     model = DeepCNN()
     opt = adam(1e-3)
     state = shard_state_tp(create_train_state(model, opt, seed=0), mesh)
-    step = make_tp_train_step(model, opt, mesh, keep_prob=0.75, donate=False)
+    # donate=True: the production-loop configuration — donation must not
+    # let sharding propagation drift the layout either
+    step = make_tp_train_step(model, opt, mesh, keep_prob=0.75, donate=True)
     batch = stage_batch_tp(mesh, _batch(16))
     losses = []
     for _ in range(4):
