@@ -42,6 +42,10 @@ def put_device_data(split, mesh=None) -> DeviceData:
     With a mesh the arrays are replicated on every device (MNIST u8 is
     ~47 MB — cheap next to multi-GB HBM), so each data-parallel shard
     samples its sub-batch locally with no collective on the input side.
+    Multi-process (one process per host, reference topology): every host
+    already holds the full split (``MNISTDist.py:167`` semantics), so each
+    supplies its own copy to the global replicated array — each host
+    uploads only to its own chips.
     """
     x = split._raw_u8()
     y = split.labels_int.astype(np.int32)
@@ -49,6 +53,11 @@ def put_device_data(split, mesh=None) -> DeviceData:
         from distributed_tensorflow_tpu.parallel.mesh import replicated_sharding
 
         sharding = replicated_sharding(mesh)
+        if jax.process_count() > 1:
+            return DeviceData(
+                jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+                jax.make_array_from_process_local_data(sharding, np.asarray(y)),
+            )
         return DeviceData(jax.device_put(jnp.asarray(x), sharding),
                           jax.device_put(jnp.asarray(y), sharding))
     return DeviceData(jax.device_put(jnp.asarray(x)),

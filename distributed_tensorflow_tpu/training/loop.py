@@ -174,10 +174,10 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
 
     use_device_data = bool(getattr(FLAGS, "device_data", False))
     if use_device_data:
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "--device_data is single-process for now (the resident split "
-                "would need per-host placement); use the prefetch path"
+        if jax.process_count() > 1 and mesh is None:
+            raise ValueError(
+                "--device_data under multi-process requires sync mode "
+                "(a global mesh to replicate the split over)"
             )
         return _train_device_resident(
             FLAGS, ds, model, opt, state, mesh, n_chips, eval_fn, stage, clip)
@@ -193,20 +193,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     meter = Throughput(FLAGS.batch_size, n_chips)
     last_display = {}
 
-    should_stop = sv.should_stop
-    if mode == "sync" and n_procs > 1:
-        import numpy as np
-        from jax.experimental import multihost_utils
-
-        def should_stop():
-            # a stop (SIGTERM on one host, say) must take effect at the SAME
-            # step on every process — a process leaving the loop alone would
-            # deadlock the rest inside the next collective. One tiny
-            # allgather per step buys that agreement.
-            votes = multihost_utils.process_allgather(
-                np.int32(sv.should_stop())
-            )
-            return bool(votes.max())
+    should_stop = _voting_should_stop(sv) if (mode == "sync" and n_procs > 1) \
+        else sv.should_stop
 
     with sv.managed(state) as box:
         state, step = box.state, box.step
@@ -284,6 +272,23 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     )
 
 
+def _voting_should_stop(sv):
+    """Cross-process stop agreement: a stop (SIGTERM on one host, say) must
+    take effect at the SAME step on every process — a process leaving the
+    loop alone would deadlock the rest inside the next collective. One tiny
+    allgather per loop iteration buys that agreement. Shared by the
+    host-fed and device-resident loops; the protocol must stay identical
+    or hosts disagree on when to exit."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    def should_stop():
+        votes = multihost_utils.process_allgather(np.int32(sv.should_stop()))
+        return bool(votes.max())
+
+    return should_stop
+
+
 def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                            eval_fn, stage, grad_transform=None) -> TrainResult:
     """--device_data training: the split resident in HBM, batches sampled on
@@ -338,17 +343,22 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
     sync_every = collective_sync_cadence(mesh is not None)
     chunks_done = 0
 
+    should_stop = _voting_should_stop(sv) if jax.process_count() > 1 \
+        else sv.should_stop
+
     with sv.managed(state) as box:
         state, step = box.state, box.step
         compile_done = False
         profiling = False
         profile_done = not FLAGS.profile_dir
         meter.reset()
-        while not sv.should_stop() and step < FLAGS.training_iter:
+        while not should_stop() and step < FLAGS.training_iter:
             if step % FLAGS.display_step == 0:
                 # reference display semantics: dropout-off eval of a fresh
-                # minibatch before training continues (MNISTDist.py:179-182)
-                b = ds.train.next_batch(FLAGS.batch_size)
+                # minibatch before training continues (MNISTDist.py:179-182).
+                # Multi-process: each host draws its SLICE of the global
+                # batch — stage() assembles slices into the global array
+                b = ds.train.next_batch(local_batch_size(FLAGS.batch_size))
                 staged = stage(b) if stage is not None else jax.device_put(b)
                 m = eval_fn(state.params, staged, state.model_state)
                 last_display = {k: float(v) for k, v in m.items()}

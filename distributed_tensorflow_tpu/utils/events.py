@@ -1,0 +1,116 @@
+"""TensorBoard event-file writer — pure Python, no TensorFlow dependency.
+
+The reference wires a summary op into its Supervisor
+(``tf.merge_all_summaries`` -> event files, ``MNISTDist.py:155,162``); this
+is the equivalent sink for this framework's scalars. Files are standard
+``events.out.tfevents.*`` logs TensorBoard reads directly:
+
+  TFRecord framing: u64 length | u32 masked_crc32c(length) | payload
+                    | u32 masked_crc32c(payload)
+  payload: a tensorflow.Event proto — hand-encoded here (the subset used:
+  wall_time=1 double, step=2 int64, file_version=3 string,
+  summary=5 { repeated Value { tag=1 string, simple_value=2 float } })
+
+Only scalar summaries are emitted, which is exactly what the reference's
+training produces (its summary op merges nothing beyond Supervisor
+defaults — SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+
+# ------------------------------------------------------------- crc32c
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ------------------------------------------------------------- protobuf
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _len_delimited(field: int, payload: bytes) -> bytes:
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _scalar_value(tag: str, value: float) -> bytes:
+    body = _len_delimited(1, tag.encode())  # Value.tag = 1
+    body += _varint((2 << 3) | 5) + struct.pack("<f", float(value))  # simple_value = 2
+    return body
+
+
+def _event(wall_time: float, step: int, *, file_version: str | None = None,
+           scalars: dict | None = None) -> bytes:
+    body = _varint((1 << 3) | 1) + struct.pack("<d", wall_time)  # wall_time = 1
+    body += _varint(2 << 3) + _varint(int(step))  # step = 2 (varint)
+    if file_version is not None:
+        body += _len_delimited(3, file_version.encode())  # file_version = 3
+    if scalars:
+        summary = b"".join(
+            _len_delimited(1, _scalar_value(tag, v))  # Summary.value = 1
+            for tag, v in sorted(scalars.items())
+        )
+        body += _len_delimited(5, summary)  # Event.summary = 5
+    return body
+
+
+# ------------------------------------------------------------- writer
+
+class EventFileWriter:
+    """Append-only TensorBoard scalar log for one run directory."""
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        name = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        self.path = os.path.join(logdir, name)
+        self._file = open(self.path, "ab")
+        self._write(_event(time.time(), 0, file_version="brain.Event:2"))
+
+    def _write(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._file.write(header)
+        self._file.write(struct.pack("<I", _masked_crc(header)))
+        self._file.write(payload)
+        self._file.write(struct.pack("<I", _masked_crc(payload)))
+
+    def add_scalars(self, step: int, scalars: dict) -> None:
+        clean = {k: float(v) for k, v in scalars.items()
+                 if isinstance(v, (int, float))}
+        if clean:
+            self._write(_event(time.time(), step, scalars=clean))
+            self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None

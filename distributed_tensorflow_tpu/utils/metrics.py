@@ -1,18 +1,19 @@
-"""Metrics/observability: the reference's stdout format + scalar files.
+"""Metrics/observability: the reference's stdout format + scalar sinks.
 
-The reference's only observability is the cadenced print
-(``MNISTDist.py:183-186``) and a summary op that merges nothing
-(``:155`` — no summaries are ever defined, SURVEY.md §5). Here the same
-stdout line is reproduced verbatim-format, and every scalar also lands in
-a JSONL file any plotting tool can read — the working replacement for the
-event-file writer.
-"""
+The reference's observability is the cadenced print
+(``MNISTDist.py:183-186``) and a summary op wired into the Supervisor's
+event files (``:155,162`` — though it merges nothing, SURVEY.md §5). Here
+the same stdout line is reproduced verbatim-format, and every scalar lands
+in BOTH a JSONL file (any plotting tool) and a TensorBoard event file
+(utils/events.py — the summary-writer parity path)."""
 
 from __future__ import annotations
 
 import json
 import os
 import time
+
+from distributed_tensorflow_tpu.utils.events import EventFileWriter
 
 
 def reference_log_line(job_name: str, task_index: int, step: int, loss, acc) -> str:
@@ -32,16 +33,18 @@ def reference_log_line(job_name: str, task_index: int, step: int, loss, acc) -> 
 
 
 class MetricsLogger:
-    """Scalar logger: stdout (reference format) + JSONL scalars file."""
+    """Scalar logger: stdout (reference format) + JSONL + TB event file."""
 
     def __init__(self, logdir: str | None = None, job_name: str = "worker",
                  task_index: int = 0, filename: str = "metrics.jsonl"):
         self.job_name = job_name or "worker"
         self.task_index = task_index
         self._file = None
+        self._events = None
         if logdir:
             os.makedirs(logdir, exist_ok=True)
             self._file = open(os.path.join(logdir, filename), "a", buffering=1)
+            self._events = EventFileWriter(logdir)
 
     def log_display(self, step: int, loss, acc):
         print(reference_log_line(self.job_name, self.task_index, step, loss, acc))
@@ -52,8 +55,13 @@ class MetricsLogger:
             rec = {"step": int(step), "time": time.time(),
                    "job": f"{self.job_name}/{self.task_index}", **values}
             self._file.write(json.dumps(rec) + "\n")
+        if self._events is not None:
+            self._events.add_scalars(step, values)
 
     def close(self):
         if self._file is not None:
             self._file.close()
             self._file = None
+        if self._events is not None:
+            self._events.close()
+            self._events = None

@@ -55,7 +55,8 @@ def _init_cluster(process_id: int, num_processes: int, port: str):
     return jax
 
 
-def run_train_loop(process_id: int, num_processes: int, port: str, outdir: str) -> None:
+def run_train_loop(process_id: int, num_processes: int, port: str, outdir: str,
+                   device_data: bool = False) -> None:
     """Production path: flags + train(mode="sync") across 2 processes."""
     jax = _init_cluster(process_id, num_processes, port)
 
@@ -73,12 +74,19 @@ def run_train_loop(process_id: int, num_processes: int, port: str, outdir: str) 
         "--learning_rate=0.002",
         "--save_model_secs=100000",
         f"--task_index={process_id}",
+        *(["--device_data", "--device_chunk=4"] if device_data else []),
     ])
     res = train(flags.FLAGS, mode="sync")
     assert res.final_step == 12, res
     assert res.n_chips == 4 * num_processes, res
     print(f"TRAIN_OK p{process_id} step={res.final_step}", flush=True)
     jax.distributed.shutdown()
+
+
+def run_train_device(process_id: int, num_processes: int, port: str, outdir: str) -> None:
+    """--device_data across processes: the split replicated onto the global
+    mesh via make_array_from_process_local_data, chunked on-device steps."""
+    run_train_loop(process_id, num_processes, port, outdir, device_data=True)
 
 
 def run(process_id: int, num_processes: int, port: str, outdir: str) -> None:
@@ -134,5 +142,6 @@ def run(process_id: int, num_processes: int, port: str, outdir: str) -> None:
 
 if __name__ == "__main__":
     mode = sys.argv[1]
-    fn = {"step": run, "train": run_train_loop}[mode]
+    fn = {"step": run, "train": run_train_loop,
+          "train_device": run_train_device}[mode]
     fn(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5])

@@ -84,6 +84,17 @@ def test_production_train_loop_multihost(tmp_path):
     assert found is not None and found[1] == 12
 
 
+def test_device_data_train_loop_multihost(tmp_path):
+    """--device_data across 2 processes: the resident split replicates onto
+    the global mesh (make_array_from_process_local_data path in
+    put_device_data), chunked on-device-sampled steps, cross-process
+    stop-vote — the multi-host version of the zero-host-bytes mode."""
+    outs = _spawn_workers("train_device", str(tmp_path))
+    for out in outs:
+        assert "TRAIN_OK" in out, out[-2000:]
+        assert "Optimization Finished!" in out, out[-2000:]
+
+
 def test_params_identical_across_processes(multihost_params):
     """Replicated state must be bitwise identical on every host after 5
     steps — the sync-DP invariant (every process applies the same
