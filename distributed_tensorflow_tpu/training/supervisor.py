@@ -45,8 +45,38 @@ class Supervisor:
 
     def init_or_restore(self, init_state):
         """Chief restores latest checkpoint or keeps the fresh init
-        (MNISTDist.py:169-170); returns (state, start_step)."""
-        restored = self.checkpointer.restore(init_state)
+        (MNISTDist.py:169-170); returns (state, start_step).
+
+        Cross-mode compatibility (SURVEY.md §7 hard part d): ps-mode
+        checkpoints carry only {"params", "step"} — no optimizer slots or
+        rng. Restoring one into a full-TrainState run adopts its params
+        and step and keeps the fresh optimizer state. (The reverse needs
+        nothing: full-state checkpoints are a superset of the ps layout,
+        and restore ignores extra keys.)"""
+        try:
+            restored = self.checkpointer.restore(init_state)
+        except KeyError:
+            # take the fallback ONLY for a genuine ps-layout file; any
+            # other structural mismatch (renamed optimizer, new TrainState
+            # field) must stay a loud error, not a silent params-only
+            # restore that resets optimizer slots
+            if not (hasattr(init_state, "params")
+                    and self._latest_is_params_only()):
+                raise
+            partial = self.checkpointer.restore(
+                {"params": init_state.params, "step": 0})
+            if partial is None:
+                raise
+            blob, step = partial
+            print(f"restored a params-only (ps-mode) checkpoint at step "
+                  f"{step}; optimizer state starts fresh")
+            import jax.numpy as jnp
+
+            state = init_state._replace(
+                params=blob["params"],
+                step=jnp.asarray(step, init_state.step.dtype),
+            )
+            return state, step
         if restored is None:
             return init_state, 0
         state, step = restored
@@ -54,6 +84,25 @@ class Supervisor:
 
     def maybe_checkpoint(self, state, step: int):
         return self.checkpointer.maybe_save(state, step)
+
+    def _latest_is_params_only(self) -> bool:
+        """True when the newest checkpoint holds exactly the ps-mode
+        {"params", "step"} layout (utils/pytree path keys)."""
+        from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+            latest_checkpoint,
+        )
+
+        found = latest_checkpoint(self.checkpointer.directory)
+        if found is None:
+            return False
+        import numpy as np
+
+        with np.load(found[0]) as z:
+            keys = {k[len("__bf16__"):] if k.startswith("__bf16__") else k
+                    for k in z.files}
+        return bool(keys) and all(
+            k == "step" or k.startswith("params/") for k in keys
+        )
 
     def _install_signal_handlers(self):
         """SIGTERM/SIGINT -> request_stop, so the loop exits cleanly and

@@ -109,3 +109,49 @@ def test_supervisor_saves_on_error(tmp_path):
             box.update(state, 3)
             raise RuntimeError("worker died")
     assert latest_checkpoint(str(tmp_path))[1] == 3
+
+
+def test_cross_mode_restore_ps_checkpoint_into_trainstate(tmp_path):
+    """SURVEY §7 hard part (d): one checkpoint layout across modes. A
+    ps-mode checkpoint ({"params","step"} only) restores into a full
+    TrainState run — params and step adopted, optimizer state fresh."""
+    state = _state()
+    trained_params = jax.tree.map(lambda p: p + 1.0, state.params)
+    save_checkpoint(str(tmp_path), {"params": trained_params, "step": 40}, 40)
+
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path), save_model_secs=0)
+    restored, step = sv.init_or_restore(state)
+    assert step == 40
+    assert int(restored.step) == 40
+    for a, b in zip(jax.tree.leaves(trained_params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # optimizer slots untouched (sgd: empty tuple) and rng kept fresh
+    assert restored.opt_state == state.opt_state
+
+
+def test_cross_mode_restore_trainstate_checkpoint_into_ps_layout(tmp_path):
+    """Reverse direction: the ps worker's {"params","step"} template reads
+    a full-TrainState checkpoint (extra keys ignored)."""
+    state = _state()
+    save_checkpoint(str(tmp_path), state._replace(step=jnp.int32(7)), 7)
+    blob, step = restore_latest(str(tmp_path),
+                                {"params": state.params, "step": 0})
+    assert step == 7
+    assert int(np.asarray(blob["step"])) == 7
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(blob["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_structural_mismatch_stays_loud(tmp_path):
+    """A full-state checkpoint whose non-params layout no longer matches
+    the template (e.g. optimizer switched sgd->adam between runs) must NOT
+    silently fall back to a params-only restore."""
+    from distributed_tensorflow_tpu.training import adam
+
+    save_checkpoint(str(tmp_path), _state(), 5)  # sgd layout on disk
+    adam_state = create_train_state(DeepCNN(), adam(1e-3), seed=0)
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path), save_model_secs=0)
+    with pytest.raises(KeyError, match="opt_state"):
+        sv.init_or_restore(adam_state)

@@ -115,6 +115,29 @@ def test_dp_device_step_replicated_and_finite(ds):
         np.testing.assert_array_equal(shards[0], s)
 
 
+def test_device_step_stateful_model():
+    """Batch-norm models thread model_state through the scan body: the
+    ResNet's EMA stats must actually update across a chunk."""
+    from distributed_tensorflow_tpu.data import read_data_sets
+    from distributed_tensorflow_tpu.models import ResNet20
+    from distributed_tensorflow_tpu.training import get_optimizer
+
+    ds = read_data_sets("/nonexistent", one_hot=True, dataset="cifar10")
+    data = put_device_data(ds.train)
+    model = ResNet20()
+    opt = get_optimizer("momentum", 0.1)
+    state = create_train_state(model, opt, seed=0)
+    before = np.asarray(
+        jax.tree.leaves(state.model_state)[0]).copy()
+    step = make_device_train_step(model, opt, 8, keep_prob=1.0, chunk=2,
+                                  donate=False)
+    state, m = step(state, data)
+    assert int(state.step) == 2
+    assert np.isfinite(float(m["loss"]))
+    after = np.asarray(jax.tree.leaves(state.model_state)[0])
+    assert not np.allclose(before, after), "BN stats never updated"
+
+
 def test_dp_device_step_batch_divisibility():
     from distributed_tensorflow_tpu.parallel import make_mesh
 
