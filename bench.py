@@ -22,7 +22,12 @@ until test accuracy >= 99% (budget-capped); reports accuracy, wall-clock
 seconds and steps to target. Real MNIST IDX files when present in
 /tmp/mnist-data, else the procedural set ("data_source" says which).
 
-Phase 4 — measured same-machine baseline
+Phase 4 — ResNet-20 on CIFAR-10 (BASELINE config 4): device-resident
+throughput of the batch-norm model, reported as
+"resnet20_cifar10_images_per_sec_per_chip" (real CIFAR pickles from
+/tmp/cifar10-data when present, else the procedural set).
+
+Phase 5 — measured same-machine baseline
 ("feeddict_images_per_sec_per_chip"): a direct transplant of the
 reference's training configuration onto this chip — per-step synchronous
 upload of an f32-pixel + one-hot-f32 batch of 128 (the feed_dict pattern,
@@ -126,36 +131,45 @@ def _device_chunk_fn(model, opt, mesh, batch_size, chunk):
         model, opt, batch_size, keep_prob=0.75, chunk=chunk, donate=False)
 
 
-def device_resident_phase(ds, n_chips) -> float:
-    """Headline: images/sec/chip with the split resident in HBM and zero
-    per-step host traffic."""
+def _timed_device_phase(ds, n_chips, model, opt, per_chip_batch: int,
+                        timed_chunks: int, chunk: int) -> float:
+    """Shared recipe for the device-resident timed phases: stage the split,
+    compile + hard-readback warmup, then time ``timed_chunks`` scan chunks
+    with the CPU collective-depth cap. Returns images/sec/chip."""
     from distributed_tensorflow_tpu.data.device_data import put_device_data
-    from distributed_tensorflow_tpu.models import DeepCNN
     from distributed_tensorflow_tpu.parallel.data_parallel import replicate_state
-    from distributed_tensorflow_tpu.training import adam, create_train_state
+    from distributed_tensorflow_tpu.training import create_train_state
 
-    batch_size = PER_CHIP_BATCH * n_chips
+    batch_size = per_chip_batch * n_chips
     mesh = _mesh_or_none(n_chips)
-    model = DeepCNN(compute_dtype=jnp.bfloat16)
-    opt = adam(1e-3)
     data = put_device_data(ds.train, mesh)
     state = create_train_state(model, opt, seed=0)
     if mesh is not None:
         state = replicate_state(mesh, state)
-    chunk_fn = _device_chunk_fn(model, opt, mesh, batch_size, CHUNK)
+    chunk_fn = _device_chunk_fn(model, opt, mesh, batch_size, chunk)
 
     state, m = chunk_fn(state, data)  # compile + program/weights upload
     float(m["loss"])  # hard readback so the clock starts clean
 
     sync_every = _sync_every(n_chips)
     t0 = time.perf_counter()
-    for c in range(1, TIMED_CHUNKS + 1):
+    for c in range(1, timed_chunks + 1):
         state, m = chunk_fn(state, data)
-        if sync_every and (c * CHUNK) % sync_every < CHUNK:
+        if sync_every and (c * chunk) % sync_every < chunk:
             jax.block_until_ready(state.params)
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
-    return TIMED_CHUNKS * CHUNK * batch_size / dt / n_chips
+    return timed_chunks * chunk * batch_size / dt / n_chips
+
+
+def device_resident_phase(ds, n_chips) -> float:
+    """Headline: images/sec/chip with the split resident in HBM and zero
+    per-step host traffic."""
+    from distributed_tensorflow_tpu.models import DeepCNN
+    from distributed_tensorflow_tpu.training import adam
+
+    return _timed_device_phase(ds, n_chips, DeepCNN(compute_dtype=jnp.bfloat16),
+                               adam(1e-3), PER_CHIP_BATCH, TIMED_CHUNKS, CHUNK)
 
 
 def throughput_phase(ds, n_chips) -> float:
@@ -184,6 +198,27 @@ def throughput_phase(ds, n_chips) -> float:
     dt = time.perf_counter() - t0
     it.close()
     return WIRE_TIMED_STEPS * batch_size / dt / n_chips
+
+
+RESNET_PER_CHIP_BATCH = 256
+RESNET_TIMED_CHUNKS = 4
+RESNET_CHUNK = 10
+
+
+def resnet_phase(n_chips, data_dir: str = "/tmp/cifar10-data") -> float:
+    """BASELINE config 4: ResNet-20 on CIFAR-10 images/sec/chip (stresses
+    XLA conv fusion + batch-norm state threading). Device-resident input,
+    same recipe as the headline phase; real CIFAR pickles when present in
+    ``data_dir``, the procedural fallback otherwise."""
+    from distributed_tensorflow_tpu.data import read_data_sets
+    from distributed_tensorflow_tpu.models import ResNet20
+    from distributed_tensorflow_tpu.training import get_optimizer
+
+    ds = read_data_sets(data_dir, one_hot=True, dataset="cifar10")
+    return _timed_device_phase(
+        ds, n_chips, ResNet20(compute_dtype=jnp.bfloat16),
+        get_optimizer("momentum", 0.1), RESNET_PER_CHIP_BATCH,
+        RESNET_TIMED_CHUNKS, RESNET_CHUNK)
 
 
 def feeddict_baseline_phase(ds, n_chips) -> float:
@@ -306,6 +341,7 @@ def main():
     wire = throughput_phase(ds, n_chips)
     conv = convergence_phase(ds, n_chips)
     feeddict = feeddict_baseline_phase(ds, n_chips)
+    resnet = resnet_phase(n_chips)
 
     print(json.dumps({
         "metric": "mnist_images_per_sec_per_chip",
@@ -319,6 +355,7 @@ def main():
         "wire_images_per_sec_per_chip": round(wire, 1),
         "feeddict_images_per_sec_per_chip": round(feeddict, 1),
         "vs_feeddict": round(per_chip / feeddict, 3),
+        "resnet20_cifar10_images_per_sec_per_chip": round(resnet, 1),
         **conv,
     }))
 

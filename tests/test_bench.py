@@ -49,6 +49,15 @@ def test_convergence_phase_runs(monkeypatch, ds, n_chips):
         assert out["steps_to_target"] is None
 
 
+def test_resnet_phase_runs(monkeypatch, tmp_path):
+    monkeypatch.setattr(bench, "RESNET_PER_CHIP_BATCH", 4)
+    monkeypatch.setattr(bench, "RESNET_TIMED_CHUNKS", 1)
+    monkeypatch.setattr(bench, "RESNET_CHUNK", 2)
+    # hermetic: an empty data_dir pins the synthetic CIFAR fallback
+    rate = bench.resnet_phase(8, data_dir=str(tmp_path / "no-cifar"))
+    assert rate > 0 and np.isfinite(rate)
+
+
 def test_feeddict_baseline_runs(monkeypatch, ds):
     monkeypatch.setattr(bench, "FEEDDICT_BATCH", 16)
     monkeypatch.setattr(bench, "FEEDDICT_STEPS", 3)
