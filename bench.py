@@ -22,12 +22,13 @@ until test accuracy >= 99% (budget-capped); reports accuracy, wall-clock
 seconds and steps to target. Real MNIST IDX files when present in
 /tmp/mnist-data, else the procedural set ("data_source" says which).
 
-Phase 4 — ResNet-20 on CIFAR-10 (BASELINE config 4): device-resident
-throughput of the batch-norm model, reported as
+Phase 5 (runs last) — ResNet-20 on CIFAR-10 (BASELINE config 4):
+device-resident throughput of the batch-norm model, reported as
 "resnet20_cifar10_images_per_sec_per_chip" (real CIFAR pickles from
-/tmp/cifar10-data when present, else the procedural set).
+/tmp/cifar10-data when present, else the procedural set —
+"resnet_data_source" says which).
 
-Phase 5 — measured same-machine baseline
+Phase 4 — measured same-machine baseline
 ("feeddict_images_per_sec_per_chip"): a direct transplant of the
 reference's training configuration onto this chip — per-step synchronous
 upload of an f32-pixel + one-hot-f32 batch of 128 (the feed_dict pattern,
@@ -205,20 +206,22 @@ RESNET_TIMED_CHUNKS = 4
 RESNET_CHUNK = 10
 
 
-def resnet_phase(n_chips, data_dir: str = "/tmp/cifar10-data") -> float:
+def resnet_phase(n_chips, data_dir: str = "/tmp/cifar10-data") -> tuple[float, str]:
     """BASELINE config 4: ResNet-20 on CIFAR-10 images/sec/chip (stresses
     XLA conv fusion + batch-norm state threading). Device-resident input,
     same recipe as the headline phase; real CIFAR pickles when present in
-    ``data_dir``, the procedural fallback otherwise."""
+    ``data_dir``, the procedural fallback otherwise. Returns
+    (rate, data_source)."""
     from distributed_tensorflow_tpu.data import read_data_sets
     from distributed_tensorflow_tpu.models import ResNet20
     from distributed_tensorflow_tpu.training import get_optimizer
 
     ds = read_data_sets(data_dir, one_hot=True, dataset="cifar10")
-    return _timed_device_phase(
+    rate = _timed_device_phase(
         ds, n_chips, ResNet20(compute_dtype=jnp.bfloat16),
         get_optimizer("momentum", 0.1), RESNET_PER_CHIP_BATCH,
         RESNET_TIMED_CHUNKS, RESNET_CHUNK)
+    return rate, ds.source
 
 
 def feeddict_baseline_phase(ds, n_chips) -> float:
@@ -341,7 +344,7 @@ def main():
     wire = throughput_phase(ds, n_chips)
     conv = convergence_phase(ds, n_chips)
     feeddict = feeddict_baseline_phase(ds, n_chips)
-    resnet = resnet_phase(n_chips)
+    resnet, resnet_source = resnet_phase(n_chips)
 
     print(json.dumps({
         "metric": "mnist_images_per_sec_per_chip",
@@ -356,6 +359,7 @@ def main():
         "feeddict_images_per_sec_per_chip": round(feeddict, 1),
         "vs_feeddict": round(per_chip / feeddict, 3),
         "resnet20_cifar10_images_per_sec_per_chip": round(resnet, 1),
+        "resnet_data_source": resnet_source,
         **conv,
     }))
 

@@ -104,8 +104,25 @@ def tp_state_sharding(state: TrainState, mesh: Mesh) -> TrainState:
 
 
 def shard_state_tp(state: TrainState, mesh: Mesh) -> TrainState:
-    """Place a host-built TrainState with the TP layout."""
-    return jax.device_put(state, tp_state_sharding(state, mesh))
+    """Place a host-built TrainState with the TP layout.
+
+    Multi-process (one process per host over a global mesh): ``device_put``
+    cannot address other hosts' devices, but every host holds the full
+    value, so each leaf is assembled with ``make_array_from_callback`` —
+    each host materializes exactly the shards its own devices need."""
+    shardings = tp_state_sharding(state, mesh)
+    if jax.process_count() > 1:
+        import numpy as np
+
+        def place(x, s):
+            if isinstance(x, jax.Array) and x.sharding == s:
+                return x  # already placed (restage of a fresh state)
+            host = np.asarray(x)
+            return jax.make_array_from_callback(
+                host.shape, s, lambda idx: host[idx])
+
+        return jax.tree.map(place, state, shardings)
+    return jax.device_put(state, shardings)
 
 
 def make_tp_train_step(model, optimizer, mesh: Mesh, keep_prob: float = 1.0,

@@ -122,7 +122,6 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             make_tp_train_step,
             shard_state_tp,
             stage_batch_tp,
-            tp_state_sharding,
         )
 
         if getattr(FLAGS, "device_data", False):
@@ -151,7 +150,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                                      grad_transform=clip)
         eval_fn = make_tp_eval_step(model)
         stage = lambda b: stage_batch_tp(mesh, b)
-        restage = lambda s: jax.device_put(s, tp_state_sharding(s, mesh))
+        restage = lambda s: shard_state_tp(s, mesh)
     elif mode == "sync":
         mesh = make_mesh()
         n_chips = mesh.devices.size

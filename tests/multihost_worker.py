@@ -56,7 +56,7 @@ def _init_cluster(process_id: int, num_processes: int, port: str):
 
 
 def run_train_loop(process_id: int, num_processes: int, port: str, outdir: str,
-                   device_data: bool = False) -> None:
+                   extra_flags: tuple = ()) -> None:
     """Production path: flags + train(mode="sync") across 2 processes."""
     jax = _init_cluster(process_id, num_processes, port)
 
@@ -74,7 +74,7 @@ def run_train_loop(process_id: int, num_processes: int, port: str, outdir: str,
         "--learning_rate=0.002",
         "--save_model_secs=100000",
         f"--task_index={process_id}",
-        *(["--device_data", "--device_chunk=4"] if device_data else []),
+        *extra_flags,
     ])
     res = train(flags.FLAGS, mode="sync")
     assert res.final_step == 12, res
@@ -86,7 +86,15 @@ def run_train_loop(process_id: int, num_processes: int, port: str, outdir: str,
 def run_train_device(process_id: int, num_processes: int, port: str, outdir: str) -> None:
     """--device_data across processes: the split replicated onto the global
     mesh via make_array_from_process_local_data, chunked on-device steps."""
-    run_train_loop(process_id, num_processes, port, outdir, device_data=True)
+    run_train_loop(process_id, num_processes, port, outdir,
+                   ("--device_data", "--device_chunk=4"))
+
+
+def run_train_tp(process_id: int, num_processes: int, port: str, outdir: str) -> None:
+    """--model_axis=2 across processes: TP+DP over the global mesh, state
+    placed per-host via make_array_from_callback (shard_state_tp)."""
+    run_train_loop(process_id, num_processes, port, outdir,
+                   ("--model_axis=2",))
 
 
 def run(process_id: int, num_processes: int, port: str, outdir: str) -> None:
@@ -143,5 +151,5 @@ def run(process_id: int, num_processes: int, port: str, outdir: str) -> None:
 if __name__ == "__main__":
     mode = sys.argv[1]
     fn = {"step": run, "train": run_train_loop,
-          "train_device": run_train_device}[mode]
+          "train_device": run_train_device, "train_tp": run_train_tp}[mode]
     fn(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5])

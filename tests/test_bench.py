@@ -54,8 +54,9 @@ def test_resnet_phase_runs(monkeypatch, tmp_path):
     monkeypatch.setattr(bench, "RESNET_TIMED_CHUNKS", 1)
     monkeypatch.setattr(bench, "RESNET_CHUNK", 2)
     # hermetic: an empty data_dir pins the synthetic CIFAR fallback
-    rate = bench.resnet_phase(8, data_dir=str(tmp_path / "no-cifar"))
+    rate, source = bench.resnet_phase(8, data_dir=str(tmp_path / "no-cifar"))
     assert rate > 0 and np.isfinite(rate)
+    assert source == "synthetic"
 
 
 def test_feeddict_baseline_runs(monkeypatch, ds):

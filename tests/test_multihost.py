@@ -95,6 +95,16 @@ def test_device_data_train_loop_multihost(tmp_path):
         assert "Optimization Finished!" in out, out[-2000:]
 
 
+def test_tp_train_loop_multihost(tmp_path):
+    """--model_axis=2 across 2 processes: the FC stack column/row-split
+    over the global mesh's model axis, per-host state placement via
+    make_array_from_callback, per-host batch slices through shard_batch."""
+    outs = _spawn_workers("train_tp", str(tmp_path))
+    for out in outs:
+        assert "TRAIN_OK" in out, out[-2000:]
+        assert "Optimization Finished!" in out, out[-2000:]
+
+
 def test_params_identical_across_processes(multihost_params):
     """Replicated state must be bitwise identical on every host after 5
     steps — the sync-DP invariant (every process applies the same
