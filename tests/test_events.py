@@ -125,6 +125,26 @@ def test_non_numeric_scalars_skipped(tmp_path):
     assert events[1]["scalars"] == {"x": 2.0}
 
 
+def test_real_tensorboard_reads_our_files(tmp_path):
+    """The ultimate compatibility check: TensorBoard's own event reader
+    (the actual consumer) parses the files this writer produces."""
+    ea = pytest.importorskip(
+        "tensorboard.backend.event_processing.event_accumulator")
+
+    w = EventFileWriter(str(tmp_path))
+    w.add_scalars(3, {"loss": 2.5})
+    w.add_scalars(6, {"loss": 1.25, "accuracy": 0.5})
+    w.close()
+
+    acc = ea.EventAccumulator(str(tmp_path))
+    acc.Reload()
+    assert set(acc.Tags()["scalars"]) == {"loss", "accuracy"}
+    losses = acc.Scalars("loss")
+    assert [(e.step, e.value) for e in losses] == [(3, 2.5), (6, 1.25)]
+    accs = acc.Scalars("accuracy")
+    assert accs[0].step == 6 and accs[0].value == 0.5
+
+
 def test_metrics_logger_writes_event_file(tmp_path, capsys):
     logger = MetricsLogger(str(tmp_path), job_name="worker", task_index=0)
     logger.log_display(100, 0.5, 0.9)
