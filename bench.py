@@ -22,11 +22,18 @@ until test accuracy >= 99% (budget-capped); reports accuracy, wall-clock
 seconds and steps to target. Real MNIST IDX files when present in
 /tmp/mnist-data, else the procedural set ("data_source" says which).
 
-Phase 5 (runs last) — ResNet-20 on CIFAR-10 (BASELINE config 4):
-device-resident throughput of the batch-norm model, reported as
+Phase 5 — ResNet-20 on CIFAR-10 (BASELINE config 4): device-resident
+throughput of the batch-norm model, reported as
 "resnet20_cifar10_images_per_sec_per_chip" (real CIFAR pickles from
 /tmp/cifar10-data when present, else the procedural set —
 "resnet_data_source" says which).
+
+Phase 6 (runs last) — async PS emulation (BASELINE config 5): one ps task
++ one worker on localhost (in-process server thread, TCP loopback), the
+reference's pull/compute/push cycle at batch 128, reported as
+"ps_emulation_images_per_sec". This measures the stale-gradient topology's
+end-to-end cycle including the full parameter transfer each step — the
+cost structure the sync/device modes exist to eliminate (SURVEY.md §3.4).
 
 Phase 4 — measured same-machine baseline
 ("feeddict_images_per_sec_per_chip"): a direct transplant of the
@@ -224,6 +231,57 @@ def resnet_phase(n_chips, data_dir: str = "/tmp/cifar10-data") -> tuple[float, s
     return rate, ds.source
 
 
+PS_BATCH = 128
+PS_STEPS = 30
+
+
+def ps_emulation_phase(ds) -> float:
+    """BASELINE config 5: the async parameter-server topology's cycle rate
+    (images/sec for ONE worker) — pull params over TCP, grads on the chip,
+    push back, ps-side SGD apply."""
+    from distributed_tensorflow_tpu.models import DeepCNN
+    from distributed_tensorflow_tpu.parallel.ps_emulation import (
+        PSClient,
+        PSServer,
+        assign_shards,
+        flatten_params,
+        make_grad_fn,
+        unflatten_params,
+    )
+
+    server = PSServer(0, "127.0.0.1:0")
+    server.start_background()
+    client = PSClient([server.address])
+    try:
+        model = DeepCNN()
+        template = model.init(jax.random.PRNGKey(0))
+        flat = flatten_params(template)
+        assignment = assign_shards(list(flat), 1)
+        client.init_params(flat, assignment, optimizer="sgd",
+                           learning_rate=0.01)
+        grad_fn = make_grad_fn(model, keep_prob=0.75,
+                               devices=jax.devices()[:1])
+
+        def cycle(rng):
+            cur, _ = client.pull_all()
+            params = unflatten_params(template, cur)
+            batch = ds.train.next_batch(PS_BATCH)
+            grads, m = grad_fn(params, batch, rng)
+            float(m["loss"])  # drain the device before the push
+            client.push_grads(flatten_params(grads), assignment)
+
+        rng = jax.random.PRNGKey(1)
+        cycle(rng)  # warmup: compile + first program upload
+        t0 = time.perf_counter()
+        for i in range(PS_STEPS):
+            cycle(jax.random.fold_in(rng, i))
+        dt = time.perf_counter() - t0
+        return PS_STEPS * PS_BATCH / dt
+    finally:
+        client.close()
+        server.close()
+
+
 def feeddict_baseline_phase(ds, n_chips) -> float:
     """Measured same-machine baseline: the reference's per-step host feed
     (f32 pixels + one-hot f32 labels uploaded synchronously each step,
@@ -345,6 +403,7 @@ def main():
     conv = convergence_phase(ds, n_chips)
     feeddict = feeddict_baseline_phase(ds, n_chips)
     resnet, resnet_source = resnet_phase(n_chips)
+    ps_rate = ps_emulation_phase(ds)
 
     print(json.dumps({
         "metric": "mnist_images_per_sec_per_chip",
@@ -360,6 +419,7 @@ def main():
         "vs_feeddict": round(per_chip / feeddict, 3),
         "resnet20_cifar10_images_per_sec_per_chip": round(resnet, 1),
         "resnet_data_source": resnet_source,
+        "ps_emulation_images_per_sec": round(ps_rate, 1),
         **conv,
     }))
 

@@ -59,6 +59,13 @@ def test_resnet_phase_runs(monkeypatch, tmp_path):
     assert source == "synthetic"
 
 
+def test_ps_emulation_phase_runs(monkeypatch, ds):
+    monkeypatch.setattr(bench, "PS_BATCH", 16)
+    monkeypatch.setattr(bench, "PS_STEPS", 3)
+    rate = bench.ps_emulation_phase(ds)
+    assert rate > 0 and np.isfinite(rate)
+
+
 def test_feeddict_baseline_runs(monkeypatch, ds):
     monkeypatch.setattr(bench, "FEEDDICT_BATCH", 16)
     monkeypatch.setattr(bench, "FEEDDICT_STEPS", 3)
