@@ -22,7 +22,6 @@ import re
 import tempfile
 import time
 
-import jax
 import numpy as np
 
 from distributed_tensorflow_tpu.utils.pytree import flatten_pytree, unflatten_pytree

@@ -16,7 +16,6 @@ TPU-native mapping:
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 
 

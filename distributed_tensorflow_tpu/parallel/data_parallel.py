@@ -16,13 +16,10 @@ update — into one XLA executable per chip.
 
 from __future__ import annotations
 
-import functools
-from typing import Any
 
 import jax
-import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, batch_sharding, replicated_sharding
 from distributed_tensorflow_tpu.training.train_state import (

@@ -19,7 +19,6 @@ documented on the ``--device_data`` flag.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 

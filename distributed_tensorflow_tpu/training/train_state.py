@@ -15,7 +15,6 @@ loop's termination test reads it (``:173``).
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, NamedTuple
 
 import jax

@@ -5,7 +5,6 @@ failure it guards against (adam at lr 1e-2 + dropout spikes the CNN's loss
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from distributed_tensorflow_tpu.data import read_data_sets
 from distributed_tensorflow_tpu.models import DeepCNN

@@ -1,11 +1,9 @@
 """Data layer: IDX parsing, next_batch semantics, synthetic fallback, sharding."""
 
 import gzip
-import os
 import struct
 
 import numpy as np
-import pytest
 
 from distributed_tensorflow_tpu.data import DataSet, read_data_sets
 from distributed_tensorflow_tpu.data.idx import read_idx

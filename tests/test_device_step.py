@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from distributed_tensorflow_tpu.data import read_data_sets
-from distributed_tensorflow_tpu.data.device_data import DeviceData, put_device_data
+from distributed_tensorflow_tpu.data.device_data import put_device_data
 from distributed_tensorflow_tpu.models import DeepCNN
 from distributed_tensorflow_tpu.training import adam, create_train_state, make_train_step
 from distributed_tensorflow_tpu.training.device_step import (

@@ -5,7 +5,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import PartitionSpec as P
 
 from distributed_tensorflow_tpu.models import DeepCNN
 from distributed_tensorflow_tpu.parallel import (

@@ -11,7 +11,7 @@ from jax.sharding import PartitionSpec as P
 from distributed_tensorflow_tpu.data.synthetic import synthetic_digits
 from distributed_tensorflow_tpu.models import DeepCNN
 from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
-from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
 from distributed_tensorflow_tpu.parallel.tensor_parallel import (
     make_tp_eval_step,
     make_tp_train_step,
