@@ -33,11 +33,14 @@ _SAMPLE_SALT = 0x5EED  # folds the sampling stream away from the dropout stream
 
 
 def _sampled_step_body(model, optimizer, batch_size: int, keep_prob: float,
-                       axis: str | None, grad_transform=None):
+                       axis: str | None, grad_transform=None,
+                       batch_sharding=None):
     """(state, data) -> (state, metrics): one full train step — on-device
     batch sample, forward, backward, (pmean over ``axis`` if set), update.
     ``state.rng`` advances every step, so the sampling key (a salted fold of
-    it) yields a fresh batch each iteration of a scan."""
+    it) yields a fresh batch each iteration of a scan. ``batch_sharding``
+    (global-view/GSPMD callers only) constrains the sampled batch's layout
+    so the partitioner splits the compute over the data axis."""
 
     def body(state: TrainState, data):
         rng, sub = jax.random.split(state.rng)
@@ -48,6 +51,11 @@ def _sampled_step_body(model, optimizer, batch_size: int, keep_prob: float,
             sub = jax.random.fold_in(sub, lax.axis_index(axis))
         idx = jax.random.randint(samp, (batch_size,), 0, data.num_examples)
         batch = (data.images[idx], data.labels[idx])
+        if batch_sharding is not None:
+            batch = tuple(
+                lax.with_sharding_constraint(b, s)
+                for b, s in zip(batch, batch_sharding)
+            )
 
         def loss_fn(params):
             return loss_and_metrics(model, params, batch, keep_prob=keep_prob,
@@ -113,4 +121,24 @@ def make_device_dp_train_step(model, optimizer, mesh, batch_size: int, *,
         out_specs=(P(), P()),
         check_vma=False,
     )
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def make_device_tp_train_step(model, optimizer, mesh, batch_size: int, *,
+                              keep_prob: float = 1.0, chunk: int = 1,
+                              donate: bool = True, grad_transform=None):
+    """TP(+DP) chunked step over device-resident data: global-view GSPMD
+    program — the state carries its TP layout (parallel/tensor_parallel),
+    the split is replicated, the in-program sampled batch is constrained to
+    the data axis, and XLA derives every collective. Composes the two
+    beyond-parity modes (--device_data + --model_axis)."""
+    from jax.sharding import NamedSharding
+
+    batch_sharding = (
+        NamedSharding(mesh, P(DATA_AXIS, None)),  # images [B, P]
+        NamedSharding(mesh, P(DATA_AXIS)),        # int labels [B]
+    )
+    body = _sampled_step_body(model, optimizer, batch_size, keep_prob,
+                              None, grad_transform, batch_sharding)
+    fn = _scan_chunk(body, chunk)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())

@@ -124,11 +124,6 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             stage_batch_tp,
         )
 
-        if getattr(FLAGS, "device_data", False):
-            raise NotImplementedError(
-                "--device_data composes with data parallelism only; drop "
-                "--model_axis or --device_data"
-            )
         if not has_tp_specs(state.params):
             raise ValueError(
                 f"--model_axis={model_axis} but model {FLAGS.model!r} has no "
@@ -179,7 +174,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 "(a global mesh to replicate the split over)"
             )
         return _train_device_resident(
-            FLAGS, ds, model, opt, state, mesh, n_chips, eval_fn, stage, clip)
+            FLAGS, ds, model, opt, state, mesh, n_chips, eval_fn, stage, clip,
+            tp=(mode == "sync" and model_axis > 1), restage=restage)
 
     sv = Supervisor(
         is_chief=(FLAGS.task_index == 0),
@@ -289,7 +285,8 @@ def _voting_should_stop(sv):
 
 
 def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
-                           eval_fn, stage, grad_transform=None) -> TrainResult:
+                           eval_fn, stage, grad_transform=None,
+                           tp: bool = False, restage=None) -> TrainResult:
     """--device_data training: the split resident in HBM, batches sampled on
     device, ``lax.scan`` chunks amortizing dispatch (training/device_step).
     Per training step NOTHING crosses the host boundary; per display step
@@ -300,6 +297,7 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
     from distributed_tensorflow_tpu.data.device_data import put_device_data
     from distributed_tensorflow_tpu.training.device_step import (
         make_device_dp_train_step,
+        make_device_tp_train_step,
         make_device_train_step,
     )
 
@@ -311,6 +309,13 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
               f"boundaries (dispatch amortization shrinks accordingly)")
 
     def build_chunk_fn(length: int):
+        if tp:
+            # GSPMD: the state's TP layout + the data-axis batch constraint
+            # drive the partitioner
+            return make_device_tp_train_step(
+                model, opt, mesh, FLAGS.batch_size,
+                keep_prob=FLAGS.keep_prob, chunk=length,
+                grad_transform=grad_transform)
         if mesh is not None:
             return make_device_dp_train_step(
                 model, opt, mesh, FLAGS.batch_size,
@@ -347,6 +352,10 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
 
     with sv.managed(state) as box:
         state, step = box.state, box.step
+        if restage is not None:
+            # a restored checkpoint arrives as host arrays; re-place it on
+            # the TP mesh layout (no-op for a freshly placed state)
+            state = restage(state)
         compile_done = False
         profiling = False
         profile_done = not FLAGS.profile_dir

@@ -172,20 +172,67 @@ def test_model_axis_rejects_model_without_tp_rule(tmp_path):
         flags.FLAGS._reset()
 
 
-def test_model_axis_rejects_device_data(tmp_path):
+def test_device_tp_step_keeps_layout_and_trains():
+    """make_device_tp_train_step: TP state layout + in-program sampling +
+    data-axis batch constraint compose under GSPMD."""
+    from distributed_tensorflow_tpu.data import read_data_sets
+    from distributed_tensorflow_tpu.data.device_data import put_device_data
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_device_tp_train_step,
+    )
+
+    ds = read_data_sets("/nonexistent", one_hot=True)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    data = put_device_data(ds.train, mesh)
+    model = DeepCNN()
+    opt = adam(1e-3)
+    state = shard_state_tp(create_train_state(model, opt, seed=0), mesh)
+    step = make_device_tp_train_step(model, opt, mesh, 64, keep_prob=0.75,
+                                     chunk=3, donate=False)
+    losses = []
+    for _ in range(4):
+        state, m = step(state, data)
+        losses.append(float(m["loss"]))
+    assert int(state.step) == 12
+    wd1 = state.params["weights"]["wd1"]
+    assert wd1.addressable_shards[0].data.shape == (3136, 512)
+    assert losses[-1] < losses[0]
+
+
+def test_model_axis_composes_with_device_data(tmp_path, capsys):
+    """--model_axis=2 --device_data end-to-end through train(), including
+    resume: the restored host-array checkpoint must be re-placed onto the
+    TP layout before the device-resident chunk fn sees it."""
     from distributed_tensorflow_tpu import flags
     from distributed_tensorflow_tpu.training.loop import train
 
     flags.define_reference_flags()
-    flags.FLAGS._reset()
-    flags.FLAGS._parse([
-        f"--logdir={tmp_path}/logs",
-        f"--data_dir={tmp_path}/no-data",
-        "--model_axis=2",
-        "--device_data",
-    ])
-    try:
-        with pytest.raises(NotImplementedError, match="device_data"):
-            train(flags.FLAGS, mode="sync")
-    finally:
+
+    def run(training_iter):
         flags.FLAGS._reset()
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/logs",
+            f"--data_dir={tmp_path}/no-data",
+            f"--training_iter={training_iter}",
+            "--batch_size=32",
+            "--display_step=10",
+            "--optimizer=adam",
+            "--save_model_secs=100000",
+            "--model_axis=2",
+            "--device_data",
+            "--device_chunk=10",
+        ])
+        try:
+            return train(flags.FLAGS, mode="sync")
+        finally:
+            flags.FLAGS._reset()
+
+    res = run(20)
+    assert res.final_step == 20
+    assert res.n_chips == 8
+    assert res.test_metrics is not None
+    out = capsys.readouterr().out
+    assert "Optimization Finished!" in out
+    # resume from the step-20 checkpoint: restage restores the TP layout
+    res2 = run(30)
+    assert res2.final_step == 30
