@@ -176,3 +176,13 @@ def define_reference_flags():
                    "'model' axis (sync mode): the CNN's FC stack is "
                    "column/row-split and XLA inserts the collectives. "
                    "1 = pure data parallelism (reference-equivalent)")
+    DEFINE_string("lr_schedule", "constant", "Learning-rate schedule: "
+                  "constant|cosine|linear|exponential — evaluated inside "
+                  "the compiled step (reference: constant). Decays over "
+                  "--decay_steps from --learning_rate")
+    DEFINE_integer("warmup_steps", 0, "Linear learning-rate warmup steps "
+                   "before --lr_schedule takes over (0 = none)")
+    DEFINE_integer("decay_steps", 0, "Schedule decay horizon in steps "
+                   "(0 = the full --training_iter budget)")
+    DEFINE_float("decay_rate", 0.96, "Decay factor per --decay_steps for "
+                 "--lr_schedule=exponential")

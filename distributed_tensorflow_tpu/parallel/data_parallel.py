@@ -103,7 +103,8 @@ def make_dp_train_step(model, optimizer, mesh, keep_prob: float = 1.0, donate: b
         model_state = aux["model_state"]
         if model_state:
             model_state = lax.pmean(model_state, DATA_AXIS)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params, state.step)
         params = apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1, rng, model_state), metrics
 

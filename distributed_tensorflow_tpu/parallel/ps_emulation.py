@@ -494,6 +494,16 @@ def run_worker(cluster, FLAGS) -> int:
     from distributed_tensorflow_tpu.training.train_state import evaluate
     from distributed_tensorflow_tpu.utils import MetricsLogger
 
+    if (getattr(FLAGS, "lr_schedule", "constant") != "constant"
+            or getattr(FLAGS, "warmup_steps", 0) > 0):
+        # loud, not silent: the ps applies a fixed rate pushed at init
+        # (reference parity — ApplyGradientDescent with a constant lr,
+        # MNISTDist.py:149); a schedule would silently not happen here
+        raise ValueError(
+            "--lr_schedule/--warmup_steps are not supported in ps mode; "
+            "the parameter server applies a fixed learning rate. Use "
+            "sync/local mode for scheduled learning rates."
+        )
     ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
                         seed=FLAGS.seed + FLAGS.task_index)
     model = build_model_for(FLAGS, ds.meta)

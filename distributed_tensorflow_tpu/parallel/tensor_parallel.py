@@ -79,25 +79,29 @@ def _map_specs(tree, specs_like, mesh):
     )
 
 
+def _opt_sharding(entry, params_structure, pspecs, mesh, rep):
+    """Shardings for one opt_state subtree, by structure rather than by
+    optimizer name: a subtree that mirrors params (velocity/moment trees)
+    takes the params specs; dicts recurse per slot; anything else (step
+    counts and other scalar slots — e.g. a schedule's ``t``) replicates.
+    This keeps every current and future slot layout working without a
+    per-optimizer special case."""
+    if jax.tree.structure(entry) == params_structure:
+        return _map_specs(entry, pspecs, mesh)
+    if isinstance(entry, dict):
+        return {k: _opt_sharding(v, params_structure, pspecs, mesh, rep)
+                for k, v in entry.items()}
+    return jax.tree.map(lambda _: rep, entry)
+
+
 def tp_state_sharding(state: TrainState, mesh: Mesh) -> TrainState:
     """Sharding pytree matching ``state``: params (and their optimizer
     slots) follow ``tp_param_specs``; scalars and rng replicated."""
     pspecs = tp_param_specs(state.params)
     rep = NamedSharding(mesh, P())
     params_sh = _map_specs(state.params, pspecs, mesh)
-
-    opt = state.opt_state
-    if opt == ():
-        opt_sh: object = ()
-    elif isinstance(opt, dict) and "m" in opt and "v" in opt:  # adam
-        opt_sh = {
-            "m": _map_specs(opt["m"], pspecs, mesh),
-            "v": _map_specs(opt["v"], pspecs, mesh),
-            "t": rep,
-        }
-    else:  # momentum: a params-shaped velocity tree
-        opt_sh = _map_specs(opt, pspecs, mesh)
-
+    opt_sh = _opt_sharding(state.opt_state, jax.tree.structure(state.params),
+                           pspecs, mesh, rep)
     model_state_sh = jax.tree.map(lambda _: rep, state.model_state)
     return TrainState(params=params_sh, opt_state=opt_sh, step=rep, rng=rep,
                       model_state=model_state_sh)

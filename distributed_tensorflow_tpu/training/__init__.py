@@ -1,3 +1,7 @@
+from distributed_tensorflow_tpu.training.schedules import (
+    get_schedule,
+    schedule_from_flags,
+)
 from distributed_tensorflow_tpu.training.train_state import (
     TrainState,
     create_train_state,
@@ -16,4 +20,6 @@ __all__ = [
     "sgd",
     "adam",
     "get_optimizer",
+    "get_schedule",
+    "schedule_from_flags",
 ]

@@ -71,7 +71,8 @@ def _sampled_step_body(model, optimizer, batch_size: int, keep_prob: float,
                 model_state = lax.pmean(model_state, axis)
         if grad_transform is not None:
             grads = grad_transform(grads)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params, state.step)
         params = apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1, rng, model_state), metrics
 

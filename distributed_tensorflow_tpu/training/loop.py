@@ -38,6 +38,7 @@ from distributed_tensorflow_tpu.training import (
     get_optimizer,
     make_eval_step,
     make_train_step,
+    schedule_from_flags,
 )
 from distributed_tensorflow_tpu.training.supervisor import Supervisor
 from distributed_tensorflow_tpu.training.train_state import evaluate
@@ -91,7 +92,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
                         seed=data_seed, validation_size=FLAGS.validation_size)
     model = build_model_for(FLAGS, ds.meta)
-    opt = get_optimizer(FLAGS.optimizer, FLAGS.learning_rate)
+    opt = get_optimizer(FLAGS.optimizer, schedule_from_flags(FLAGS))
     state = create_train_state(model, opt, seed=FLAGS.seed)
 
     n_chips = 1

@@ -55,13 +55,21 @@ class Supervisor:
         and restore ignores extra keys.)"""
         try:
             restored = self.checkpointer.restore(init_state)
-        except KeyError:
+        except KeyError as e:
             # take the fallback ONLY for a genuine ps-layout file; any
             # other structural mismatch (renamed optimizer, new TrainState
             # field) must stay a loud error, not a silent params-only
             # restore that resets optimizer slots
             if not (hasattr(init_state, "params")
                     and self._latest_is_params_only()):
+                if "opt_state" in str(e):
+                    # the most common way to hit this: switching
+                    # --optimizer between runs changes the slot layout
+                    raise KeyError(
+                        f"{e.args[0] if e.args else e} — note: the optimizer "
+                        f"state layout depends on --optimizer; resume with "
+                        f"the same optimizer the checkpoint was written with"
+                    ) from e
                 raise
             partial = self.checkpointer.restore(
                 {"params": init_state.params, "step": 0})

@@ -35,49 +35,83 @@ class TrainState(NamedTuple):
 
 
 class Optimizer(NamedTuple):
+    # update: (grads, opt_state, params, step=None) -> (updates, opt_state).
+    # ``step`` is the global step BEFORE this update (TrainState.step);
+    # schedule-carrying optimizers evaluate their learning rate on it, so
+    # the opt_state layout never depends on whether a schedule is set and
+    # checkpoints stay compatible across --lr_schedule toggles. Plain
+    # float-lr optimizers ignore it (and tolerate it being omitted).
     init: Callable[[Any], Any]
-    update: Callable[[Any, Any, Any], tuple[Any, Any]]  # (grads, opt_state, params) -> (updates, opt_state)
+    update: Callable[..., tuple[Any, Any]]
 
 
-def sgd(learning_rate: float) -> Optimizer:
-    """Vanilla SGD — parity with ``GradientDescentOptimizer`` (MNISTDist.py:149)."""
+def _lr_at(learning_rate, step):
+    """Resolve a float-or-Schedule learning rate at ``step`` (the global
+    step before the update). A schedule with no step is a caller bug —
+    fail loudly rather than silently training at the wrong rate."""
+    if not callable(learning_rate):
+        return learning_rate
+    if step is None:
+        raise ValueError(
+            "scheduled learning rate needs the global step: call "
+            "optimizer.update(grads, opt_state, params, step)"
+        )
+    return learning_rate(step)
+
+
+def sgd(learning_rate) -> Optimizer:
+    """Vanilla SGD — parity with ``GradientDescentOptimizer`` (MNISTDist.py:149).
+
+    ``learning_rate`` is a float (reference behavior) or a
+    ``schedules.Schedule`` callable evaluated on the global step; either
+    way the opt_state is the empty tuple (the schedule reads
+    ``TrainState.step``, which checkpoints already carry)."""
 
     def init(params):
         return ()
 
-    def update(grads, opt_state, params):
-        updates = jax.tree.map(lambda g: -learning_rate * g, grads)
+    def update(grads, opt_state, params, step=None):
+        lr = _lr_at(learning_rate, step)
+        updates = jax.tree.map(lambda g: -lr * g, grads)
         return updates, opt_state
 
     return Optimizer(init, update)
 
 
-def momentum(learning_rate: float, beta: float = 0.9) -> Optimizer:
+def momentum(learning_rate, beta: float = 0.9) -> Optimizer:
+    """SGD with momentum; opt_state is the bare velocity tree regardless
+    of whether ``learning_rate`` is a float or a schedule."""
+
     def init(params):
         return jax.tree.map(jnp.zeros_like, params)
 
-    def update(grads, vel, params):
+    def update(grads, vel, params, step=None):
+        lr = _lr_at(learning_rate, step)
         vel = jax.tree.map(lambda v, g: beta * v + g, vel, grads)
-        updates = jax.tree.map(lambda v: -learning_rate * v, vel)
+        updates = jax.tree.map(lambda v: -lr * v, vel)
         return updates, vel
 
     return Optimizer(init, update)
 
 
-def adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> Optimizer:
+def adam(learning_rate, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> Optimizer:
     """Adam — not in the reference (SGD only); provided because the
-    <60s-to-99% target wants a faster optimizer than SGD@0.001."""
+    <60s-to-99% target wants a faster optimizer than SGD@0.001.
+    ``learning_rate`` may be a float or a schedule callable (evaluated on
+    the global step like the other optimizers; the ``t`` slot stays what
+    it always was — the bias-correction count)."""
 
     def init(params):
         zeros = lambda: jax.tree.map(jnp.zeros_like, params)
         return {"m": zeros(), "v": zeros(), "t": jnp.zeros((), jnp.int32)}
 
-    def update(grads, st, params):
+    def update(grads, st, params, step=None):
+        lr = _lr_at(learning_rate, step)
         t = st["t"] + 1
         m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, st["m"], grads)
         v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, st["v"], grads)
         tf_ = t.astype(jnp.float32)
-        scale = learning_rate * jnp.sqrt(1 - b2**tf_) / (1 - b1**tf_)
+        scale = lr * jnp.sqrt(1 - b2**tf_) / (1 - b1**tf_)
         updates = jax.tree.map(lambda m_, v_: -scale * m_ / (jnp.sqrt(v_) + eps), m, v)
         return updates, {"m": m, "v": v, "t": t}
 
@@ -87,7 +121,7 @@ def adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999, eps: float = 
 _OPTIMIZERS = {"sgd": sgd, "momentum": momentum, "adam": adam}
 
 
-def get_optimizer(name: str, learning_rate: float) -> Optimizer:
+def get_optimizer(name: str, learning_rate) -> Optimizer:
     try:
         return _OPTIMIZERS[name](learning_rate)
     except KeyError:
@@ -200,7 +234,8 @@ def make_train_step(
             grads = grad_transform(grads)
         if metrics_transform is not None:
             metrics = metrics_transform(metrics)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params, state.step)
         params = apply_updates(state.params, updates)
         return (
             TrainState(params, opt_state, state.step + 1, rng, model_state),

@@ -42,6 +42,14 @@ def main(_):
             print(f"--job_name must be 'ps' or 'worker' when --ps_hosts is "
                   f"set (got {FLAGS.job_name!r})", file=sys.stderr)
             return 2
+        if FLAGS.lr_schedule != "constant" or FLAGS.warmup_steps > 0:
+            # fail EVERY role fast at dispatch — the run_worker guard alone
+            # would leave ps processes blocked in serve_forever() while the
+            # workers die at startup
+            print("--lr_schedule/--warmup_steps are not supported in ps "
+                  "mode (the ps applies a fixed learning rate); use "
+                  "sync/local mode", file=sys.stderr)
+            return 2
         from distributed_tensorflow_tpu.parallel import ps_emulation
 
         if FLAGS.job_name == "ps":

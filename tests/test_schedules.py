@@ -1,0 +1,267 @@
+"""Learning-rate schedules: shapes of the curves, optimizer integration
+(the schedule compiles into the step and is evaluated on the optimizer's
+own count), checkpoint roundtrips of the scheduled opt_state, and the
+reference-parity guarantee that UNscheduled optimizers keep their exact
+opt_state layouts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models import DeepCNN
+from distributed_tensorflow_tpu.training import (
+    create_train_state,
+    get_optimizer,
+    get_schedule,
+    make_train_step,
+    sgd,
+)
+from distributed_tensorflow_tpu.training.schedules import (
+    constant,
+    cosine_decay,
+    exponential_decay,
+    linear_decay,
+    schedule_from_flags,
+    with_warmup,
+)
+
+
+def _at(schedule, step):
+    return float(schedule(jnp.asarray(step, jnp.int32)))
+
+
+def test_constant():
+    s = constant(0.1)
+    assert _at(s, 0) == pytest.approx(0.1)
+    assert _at(s, 10_000) == pytest.approx(0.1)
+
+
+def test_cosine_endpoints_and_midpoint():
+    s = cosine_decay(1.0, decay_steps=100)
+    assert _at(s, 0) == pytest.approx(1.0)
+    assert _at(s, 50) == pytest.approx(0.5, abs=1e-6)
+    assert _at(s, 100) == pytest.approx(0.0, abs=1e-6)
+    assert _at(s, 500) == pytest.approx(0.0, abs=1e-6)  # clamped, not negative
+
+
+def test_cosine_alpha_floor():
+    s = cosine_decay(1.0, decay_steps=10, alpha=0.1)
+    assert _at(s, 10) == pytest.approx(0.1, abs=1e-6)
+
+
+def test_linear():
+    s = linear_decay(2.0, decay_steps=4)
+    assert [_at(s, i) for i in range(6)] == pytest.approx(
+        [2.0, 1.5, 1.0, 0.5, 0.0, 0.0])
+
+
+def test_exponential_continuous_vs_staircase():
+    s = exponential_decay(1.0, decay_steps=10, decay_rate=0.5)
+    assert _at(s, 10) == pytest.approx(0.5)
+    assert _at(s, 5) == pytest.approx(0.5**0.5)
+    st = exponential_decay(1.0, decay_steps=10, decay_rate=0.5, staircase=True)
+    assert _at(st, 5) == pytest.approx(1.0)
+    assert _at(st, 19) == pytest.approx(0.5)
+
+
+def test_warmup_ramps_then_hands_off():
+    s = with_warmup(cosine_decay(1.0, decay_steps=100), warmup_steps=10)
+    # linear ramp to the base rate...
+    assert _at(s, 0) == pytest.approx(0.1)
+    assert _at(s, 4) == pytest.approx(0.5)
+    assert _at(s, 9) == pytest.approx(1.0)
+    # ...then the base schedule evaluated on the post-warmup step
+    assert _at(s, 10) == pytest.approx(1.0)
+    assert _at(s, 60) == pytest.approx(0.5, abs=1e-6)  # cosine midpoint
+
+
+def test_get_schedule_constant_returns_plain_float():
+    # the no-schedule case must stay a float so sgd keeps its stateless
+    # reference-parity opt_state
+    lr = get_schedule("constant", 0.01, 100)
+    assert isinstance(lr, float) and lr == 0.01
+    assert callable(get_schedule("constant", 0.01, 100, warmup_steps=5))
+    assert callable(get_schedule("cosine", 0.01, 100))
+
+
+def test_get_schedule_unknown_name():
+    with pytest.raises(ValueError, match="unknown lr_schedule"):
+        get_schedule("sawtooth", 0.1, 10)
+
+
+def test_layouts_independent_of_schedule():
+    """The opt_state layout must NOT depend on whether a schedule is set
+    (schedules read TrainState.step), so checkpoints stay compatible
+    across --lr_schedule toggles: sgd stays (), momentum stays the bare
+    velocity tree."""
+    params = {"w": jnp.ones((3,))}
+    sched = cosine_decay(0.1, 10)
+    assert sgd(0.1).init(params) == () == sgd(sched).init(params)
+    mom_plain = get_optimizer("momentum", 0.1).init(params)
+    mom_sched = get_optimizer("momentum", sched).init(params)
+    assert jax.tree.structure(mom_plain) == jax.tree.structure(mom_sched)
+
+
+def test_scheduled_update_without_step_is_loud():
+    opt = sgd(cosine_decay(0.1, 10))
+    params = {"w": jnp.ones((3,))}
+    with pytest.raises(ValueError, match="needs the global step"):
+        opt.update({"w": jnp.ones((3,))}, opt.init(params), params)
+
+
+def test_scheduled_sgd_trajectory():
+    """A scheduled sgd update must apply exactly lr(step) at each step."""
+    sched = linear_decay(1.0, decay_steps=4)
+    opt = sgd(sched)
+    params = {"w": jnp.zeros((2,))}
+    st = opt.init(params)
+    grads = {"w": jnp.ones((2,))}
+    expected = 0.0
+    for t in range(4):
+        updates, st = opt.update(grads, st, params, jnp.asarray(t, jnp.int32))
+        expected -= 1.0 - t / 4
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+    np.testing.assert_allclose(np.asarray(params["w"]), expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["sgd", "momentum", "adam"])
+def test_scheduled_optimizer_compiles_into_step(name):
+    """End-to-end: a scheduled optimizer inside the jitted train step — the
+    schedule traces once, reads the advancing global step, loss stays
+    finite."""
+    model = DeepCNN()
+    opt = get_optimizer(name, get_schedule("cosine", 1e-3, 50, warmup_steps=5))
+    state = create_train_state(model, opt, seed=0)
+    step_fn = make_train_step(model, opt, keep_prob=1.0, donate=False)
+    x = jnp.ones((4, 784), jnp.float32)
+    y = jax.nn.one_hot(jnp.arange(4) % 10, 10)
+    for _ in range(3):
+        state, m = step_fn(state, (x, y))
+    assert np.isfinite(float(m["loss"]))
+    assert int(state.step) == 3
+
+
+def test_schedule_decays_within_jitted_run():
+    """The effective rate must actually change across steps of one compiled
+    function: with lr so large that an unscheduled run would move far, a
+    fully-decayed schedule (step past the horizon) must apply ~0."""
+    sched = linear_decay(1.0, decay_steps=2)
+    opt = sgd(sched)
+    params = {"w": jnp.zeros((2,))}
+    st = opt.init(params)
+    grads = {"w": jnp.ones((2,))}
+    upd_hot, _ = opt.update(grads, st, params, jnp.asarray(0, jnp.int32))
+    upd_cold, _ = opt.update(grads, st, params, jnp.asarray(100, jnp.int32))
+    assert abs(float(upd_hot["w"][0])) == pytest.approx(1.0)
+    assert abs(float(upd_cold["w"][0])) == pytest.approx(0.0, abs=1e-7)
+
+
+def test_checkpoint_roundtrip_across_schedule_toggle(tmp_path):
+    """Both toggle directions restore cleanly (same opt_state layout), and
+    the schedule picks up at the RESTORED global step — not from the top
+    of the warmup ramp."""
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        restore_latest,
+        save_checkpoint,
+    )
+
+    model = DeepCNN()
+    plain_opt = sgd(0.1)
+    sched_opt = sgd(get_schedule("linear", 0.1, 10))
+
+    # write with the PLAIN optimizer, restore into a SCHEDULED template
+    state = create_train_state(model, plain_opt, seed=0)
+    step_fn = make_train_step(model, plain_opt, keep_prob=1.0, donate=False)
+    x = jnp.ones((2, 784), jnp.float32)
+    y = jax.nn.one_hot(jnp.arange(2) % 10, 10)
+    for _ in range(5):
+        state, _ = step_fn(state, (x, y))
+    save_checkpoint(str(tmp_path), state, int(state.step))
+
+    restored, step = restore_latest(
+        str(tmp_path), create_train_state(model, sched_opt, seed=1))
+    assert step == 5 and int(restored.step) == 5
+    # the scheduled step function continues from step 5: lr = 0.1*(1-5/10)
+    sched_step = make_train_step(model, sched_opt, keep_prob=1.0, donate=False)
+    before = np.asarray(restored.params["biases"]["out"])
+    g_state, _ = sched_step(restored, (x, y))
+    assert int(g_state.step) == 6
+    # and the reverse direction restores too
+    save_checkpoint(str(tmp_path), g_state, 6)
+    back, step6 = restore_latest(
+        str(tmp_path), create_train_state(model, plain_opt, seed=2))
+    assert step6 == 6 and int(back.step) == 6
+    assert before.shape == np.asarray(back.params["biases"]["out"]).shape
+
+
+def test_schedule_from_flags_defaults_to_training_iter():
+    class F:
+        lr_schedule = "cosine"
+        warmup_steps = 0
+        decay_steps = 0
+        decay_rate = 0.96
+        learning_rate = 1.0
+        training_iter = 200
+
+    s = schedule_from_flags(F)
+    assert _at(s, 100) == pytest.approx(0.5, abs=1e-6)
+    F.lr_schedule = "constant"
+    assert schedule_from_flags(F) == 1.0
+
+
+def test_schedule_from_flags_warmup_fits_horizon():
+    """With warmup and the default decay horizon, the schedule reaches its
+    floor exactly at --training_iter (warmup comes out of the horizon)."""
+
+    class F:
+        lr_schedule = "linear"
+        warmup_steps = 50
+        decay_steps = 0
+        decay_rate = 0.96
+        learning_rate = 1.0
+        training_iter = 200
+
+    s = schedule_from_flags(F)
+    assert _at(s, 49) == pytest.approx(1.0)  # top of the ramp
+    assert _at(s, 200) == pytest.approx(0.0, abs=1e-6)  # floor at the end
+
+
+@pytest.mark.parametrize("name", ["sgd", "momentum", "adam"])
+def test_scheduled_optimizer_under_tensor_parallelism(name):
+    """A scheduled optimizer under TP: the structural opt-state sharding
+    rule places every layout, the GSPMD step runs, slots keep their
+    param's split."""
+    from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
+    from distributed_tensorflow_tpu.parallel.tensor_parallel import (
+        make_tp_train_step,
+        shard_state_tp,
+        stage_batch_tp,
+    )
+
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    model = DeepCNN()
+    opt = get_optimizer(name, get_schedule("cosine", 1e-3, 50))
+    state = shard_state_tp(create_train_state(model, opt, seed=0), mesh)
+    step_fn = make_tp_train_step(model, opt, mesh, keep_prob=1.0, donate=False)
+    x = jnp.ones((8, 784), jnp.float32)
+    y = jax.nn.one_hot(jnp.arange(8) % 10, 10)
+    state, m = step_fn(state, stage_batch_tp(mesh, (x, y)))
+    assert np.isfinite(float(m["loss"]))
+    assert int(state.step) == 1
+    if name in ("momentum", "adam"):
+        slot = (state.opt_state if name == "momentum"
+                else state.opt_state["m"])["weights"]["wd1"]
+        # the slot follows its param's TP split
+        assert slot.addressable_shards[0].data.shape[1] == slot.shape[1] // 2
+
+
+def test_ps_mode_rejects_schedules():
+    from distributed_tensorflow_tpu.parallel.ps_emulation import run_worker
+
+    class F:
+        lr_schedule = "cosine"
+        warmup_steps = 0
+
+    with pytest.raises(ValueError, match="not supported in ps mode"):
+        run_worker(None, F)
