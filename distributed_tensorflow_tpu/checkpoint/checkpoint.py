@@ -20,6 +20,7 @@ import json
 import os
 import re
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -32,12 +33,21 @@ _PREFIX = "ckpt"
 
 def save_checkpoint(directory: str, state, step: int, max_to_keep: int = 5) -> str:
     """Atomic write of ``state`` at ``step``; returns the checkpoint path."""
+    return _write_flat(directory, flatten_pytree(state, tag_bf16=True), step,
+                       max_to_keep)
+
+
+def _write_flat(directory: str, flat: dict[str, np.ndarray], step: int,
+                max_to_keep: int) -> str:
+    """The host-side half of a save: atomic npz write + index + GC of an
+    already-fetched flat array dict (no device interaction — safe to run
+    on a background thread)."""
     os.makedirs(directory, exist_ok=True)
     final = os.path.join(directory, f"{_PREFIX}-{step}.npz")
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez(f, **flatten_pytree(state, tag_bf16=True))
+            np.savez(f, **flat)
         os.replace(tmp, final)
     except BaseException:
         if os.path.exists(tmp):
@@ -115,29 +125,140 @@ class Checkpointer:
 
     ``maybe_save`` is called every loop iteration; it writes only when
     ``save_model_secs`` have elapsed (MNISTDist.py:165) and only on the
-    chief (``:159``). ``save`` forces a write (used at shutdown)."""
+    chief (``:159``). ``save`` forces a synchronous write (used at
+    shutdown).
+
+    With ``background=True`` the file writes happen off the training
+    thread, the way the reference's Supervisor ran its Saver in background
+    service threads (MNISTDist.py:159-170): ``maybe_save`` fetches the
+    state to host on the calling thread (ordered with the dispatch queue
+    — a background thread touching the device would race other in-flight
+    multi-device programs and can deadlock XLA:CPU's collective
+    rendezvous, see PERF.md — and host copies are donation-safe by
+    construction), then hands the flat arrays to one writer thread for
+    the npz serialization, atomic rename and GC. At most one save is in
+    flight — a newer snapshot replaces an older one that has not started
+    writing (latest wins), so a slow disk can never queue up unbounded
+    checkpoints. A failed background write surfaces on the next
+    ``maybe_save``/``wait``; the forced ``save`` drains pending writes
+    first so the index always ends at the newest step."""
 
     def __init__(self, directory: str, is_chief: bool = True,
-                 save_model_secs: int = 600, max_to_keep: int = 5):
+                 save_model_secs: int = 600, max_to_keep: int = 5,
+                 background: bool = False):
         self.directory = directory
         self.is_chief = is_chief
         self.save_model_secs = save_model_secs
         self.max_to_keep = max_to_keep
+        self.background = background
         self._last_save = time.time()
+        self._cv = threading.Condition()
+        self._pending: tuple | None = None
+        self._busy = False
+        self._error: BaseException | None = None
+        self._thread: threading.Thread | None = None
+        self._closed = False
 
     def maybe_save(self, state, step: int) -> str | None:
+        """Returns the path of a checkpoint written synchronously, else
+        None. In background mode the cadenced write completes
+        asynchronously (and may be superseded by a newer one before it
+        starts — latest wins), so no path is promised; ``wait()`` then
+        ``latest_checkpoint`` observe the result."""
         if not self.is_chief or self.save_model_secs <= 0:
             return None
         if time.time() - self._last_save < self.save_model_secs:
             return None
+        if self.background:
+            self._submit(state, step)
+            self._last_save = time.time()
+            return None
         return self.save(state, step)
 
     def save(self, state, step: int) -> str | None:
+        """Forced synchronous write (shutdown path). Drains any pending
+        background write first so a stale step can never land in the index
+        after this one."""
         if not self.is_chief:
             return None
+        self._drain()
+        if self._error is not None:
+            # an older periodic write failed; this newer forced save
+            # supersedes it — report, don't mask the final save with it
+            print(f"note: a background checkpoint write had failed: "
+                  f"{self._error}")
+            self._error = None
         path = save_checkpoint(self.directory, state, step, self.max_to_keep)
         self._last_save = time.time()
         return path
 
+    def wait(self):
+        """Block until no background write is pending or running; raise if
+        one failed."""
+        self._drain()
+        self._raise_pending_error()
+
+    def close(self):
+        """Stop the writer thread after draining. Idempotent."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+            if self._thread.is_alive():
+                # do NOT pretend shutdown completed: the daemon thread is
+                # mid-write and process exit would tear the tmp file (the
+                # atomic rename means the previous checkpoint stays valid)
+                print("warning: checkpoint writer still busy after 60s; "
+                      "an in-flight write may not complete")
+            else:
+                self._thread = None
+
     def restore(self, template):
         return restore_latest(self.directory, template)
+
+    # --- background machinery ---
+
+    def _submit(self, state, step: int):
+        self._raise_pending_error()
+        flat = flatten_pytree(state, tag_bf16=True)  # device→host, ordered
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("Checkpointer is closed")
+            self._pending = (flat, step)  # replaces an unstarted older save
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._writer_loop, name="checkpoint-writer",
+                    daemon=True,
+                )
+                self._thread.start()
+            self._cv.notify_all()
+
+    def _writer_loop(self):
+        while True:
+            with self._cv:
+                while self._pending is None and not self._closed:
+                    self._cv.wait()
+                if self._pending is None:
+                    return  # closed and drained
+                (flat, step), self._pending = self._pending, None
+                self._busy = True
+            try:
+                _write_flat(self.directory, flat, step, self.max_to_keep)
+            except BaseException as e:  # noqa: BLE001 — surfaced to callers
+                with self._cv:
+                    self._error = e
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _drain(self):
+        with self._cv:
+            while self._pending is not None or self._busy:
+                self._cv.wait()
+
+    def _raise_pending_error(self):
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise RuntimeError(f"background checkpoint write failed: {e}") from e

@@ -186,3 +186,9 @@ def define_reference_flags():
                    "(0 = the full --training_iter budget)")
     DEFINE_float("decay_rate", 0.96, "Decay factor per --decay_steps for "
                  "--lr_schedule=exponential")
+    DEFINE_boolean("async_checkpoint", True, "Write cadenced checkpoints "
+                   "from a background thread (the state is fetched to "
+                   "host on the training thread, then serialized and "
+                   "written off-thread; training never blocks on the "
+                   "disk). The final checkpoint on exit is always "
+                   "synchronous")

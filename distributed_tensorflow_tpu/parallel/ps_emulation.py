@@ -517,7 +517,9 @@ def run_worker(cluster, FLAGS) -> int:
     assignment = assign_shards(list(flat_template), cluster.num_tasks("ps"))
 
     ckpt = Checkpointer(FLAGS.logdir, is_chief=is_chief,
-                        save_model_secs=FLAGS.save_model_secs)
+                        save_model_secs=FLAGS.save_model_secs,
+                        background=bool(getattr(FLAGS, "async_checkpoint",
+                                                False)))
     if is_chief:
         restored = ckpt.restore({"params": template, "step": 0})
         if restored is not None:
@@ -576,6 +578,7 @@ def run_worker(cluster, FLAGS) -> int:
         if FLAGS.test_eval:
             res = evaluate(model, params, ds.test)
             print("test accuracy: ", res["accuracy"], "test loss: ", res["loss"])
+    ckpt.close()
     print("Optimization Finished!")
     logger.close()
     return 0

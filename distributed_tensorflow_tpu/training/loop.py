@@ -182,6 +182,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         is_chief=(FLAGS.task_index == 0),
         logdir=FLAGS.logdir,
         save_model_secs=FLAGS.save_model_secs,
+        background_save=bool(getattr(FLAGS, "async_checkpoint", False)),
     )
     logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
                            job_name=FLAGS.job_name or "worker",
@@ -339,6 +340,7 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
         is_chief=(FLAGS.task_index == 0),
         logdir=FLAGS.logdir,
         save_model_secs=FLAGS.save_model_secs,
+        background_save=bool(getattr(FLAGS, "async_checkpoint", False)),
     )
     logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
                            job_name=FLAGS.job_name or "worker",

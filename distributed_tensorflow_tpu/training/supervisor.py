@@ -24,12 +24,17 @@ class Supervisor:
         logdir: str,
         save_model_secs: int = 600,
         max_to_keep: int = 5,
+        background_save: bool = False,
     ):
+        """``background_save`` moves the cadenced checkpoint writes off the
+        training thread (the reference Supervisor's Saver ran in background
+        service threads, MNISTDist.py:159-170); the final save on exit is
+        always synchronous."""
         self.is_chief = is_chief
         self.logdir = logdir
         self.checkpointer = Checkpointer(
             logdir, is_chief=is_chief, save_model_secs=save_model_secs,
-            max_to_keep=max_to_keep,
+            max_to_keep=max_to_keep, background=background_save,
         )
         self._stop = False
 
@@ -161,6 +166,7 @@ class Supervisor:
                     self.checkpointer.save(state_box.state, state_box.step)
                 except Exception as e:  # noqa: BLE001 — shutdown best-effort
                     print(f"final checkpoint failed: {e}")
+            self.checkpointer.close()
             self.stop()
 
 

@@ -1,0 +1,123 @@
+"""Background (async) checkpointing: donation safety of the device-side
+snapshot, latest-wins coalescing, final-save ordering, error propagation,
+and the Supervisor integration."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+    Checkpointer,
+    latest_checkpoint,
+    restore_latest,
+)
+from distributed_tensorflow_tpu.models import DeepCNN
+from distributed_tensorflow_tpu.training import create_train_state, sgd
+from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+
+def _due(ckpt):
+    """Make the next maybe_save consider the cadence elapsed."""
+    ckpt._last_save = time.time() - 10 * max(1, ckpt.save_model_secs)
+
+
+def _state(seed=0):
+    return create_train_state(DeepCNN(), sgd(0.1), seed=seed)
+
+
+def test_background_save_writes_and_restores(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), save_model_secs=1, background=True)
+    state = _state()
+    _due(ckpt)
+    # background mode promises no path (the write is async and latest-wins)
+    assert ckpt.maybe_save(state, 3) is None
+    ckpt.wait()
+    found = latest_checkpoint(str(tmp_path))
+    assert found is not None and found[1] == 3 and os.path.exists(found[0])
+    restored, step = restore_latest(str(tmp_path), _state(seed=1))
+    assert step == 3
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["biases"]["out"]),
+        np.asarray(state.params["biases"]["out"]))
+    ckpt.close()
+
+
+def test_background_save_is_donation_safe(tmp_path):
+    """The snapshot must survive the state being donated to the next step
+    immediately after maybe_save returns — the exact hot-loop pattern."""
+    ckpt = Checkpointer(str(tmp_path), save_model_secs=1, background=True)
+    state = _state()
+    before = np.asarray(state.params["weights"]["wd1"]).copy()
+
+    clobber_d = jax.jit(lambda s: jax.tree.map(
+        lambda x: x * 0.0 if x.dtype.kind == "f" else x, s),
+        donate_argnums=(0,))
+
+    _due(ckpt)
+    ckpt.maybe_save(state, 5)
+    state = clobber_d(state)  # donation invalidates the original buffers
+    jax.block_until_ready(state.params)
+    ckpt.wait()
+    restored, step = restore_latest(str(tmp_path), _state(seed=1))
+    assert step == 5
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["weights"]["wd1"]), before)
+    ckpt.close()
+
+
+def test_background_coalesces_latest_wins(tmp_path):
+    """Many quick submissions: no unbounded queue; the newest step's
+    checkpoint exists and the index points at it after draining."""
+    ckpt = Checkpointer(str(tmp_path), save_model_secs=1, background=True)
+    for step in range(1, 8):
+        _due(ckpt)
+        ckpt.maybe_save(_state(), step)
+    ckpt.wait()
+    found = latest_checkpoint(str(tmp_path))
+    assert found is not None and found[1] == 7
+    ckpt.close()
+
+
+def test_forced_save_drains_pending_first(tmp_path):
+    """A background save of an older step must not land in the index after
+    the forced (shutdown) save of a newer one."""
+    ckpt = Checkpointer(str(tmp_path), save_model_secs=1, background=True)
+    _due(ckpt)
+    ckpt.maybe_save(_state(), 10)
+    ckpt.save(_state(), 20)  # drains, then writes synchronously
+    found = latest_checkpoint(str(tmp_path))
+    assert found is not None and found[1] == 20
+    ckpt.close()
+
+
+def test_background_write_failure_is_loud(tmp_path):
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("x")  # makedirs inside save_checkpoint will fail
+    ckpt = Checkpointer(str(blocker), save_model_secs=1, background=True)
+    _due(ckpt)
+    ckpt.maybe_save(_state(), 1)
+    with pytest.raises(RuntimeError, match="background checkpoint write failed"):
+        # wait() drains and surfaces the writer's exception
+        ckpt.wait()
+    ckpt.close()
+
+
+def test_supervisor_background_final_checkpoint(tmp_path):
+    """Supervisor(background_save=True): cadenced saves run off-thread, the
+    managed-exit save is synchronous, and a fresh Supervisor restores it."""
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path), save_model_secs=1,
+                    background_save=True)
+    with sv.managed(_state(), handle_signals=False) as box:
+        state = box.state
+        state = state._replace(step=jnp.asarray(42, jnp.int32))
+        _due(sv.checkpointer)
+        sv.maybe_checkpoint(state, 42)
+        box.update(state, 42)
+    # managed exit: drained + final sync save at step 42
+    sv2 = Supervisor(is_chief=True, logdir=str(tmp_path), save_model_secs=1)
+    restored, step = sv2.init_or_restore(_state(seed=9))
+    assert step == 42 and int(restored.step) == 42
