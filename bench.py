@@ -61,12 +61,13 @@ import jax.numpy as jnp
 
 IMPLIED_BASELINE_IMAGES_PER_SEC_PER_CHIP = 128 * 10_000 / 60.0 / 8
 
-PER_CHIP_BATCH = 1536
+PER_CHIP_BATCH = 2048  # measured sweet spot (PERF.md sweep: beats 1536 by ~5-9%)
 CHUNK = 50          # scan length per dispatch in the device-resident phases
 TIMED_CHUNKS = 8    # 8 x 50 = 400 timed steps
 
 # thin-wire phase: one staged batch (1536 x 788 B ~= 1.2 MB) stays under
 # the host->device transfer cliff measured on tunneled chips
+WIRE_BATCH = 1536
 WIRE_TIMED_STEPS = 150
 
 TARGET_ACC = 0.99
@@ -131,12 +132,13 @@ def _device_chunk_fn(model, opt, mesh, batch_size, chunk):
         make_device_train_step,
     )
 
+    # donate: rebinding state every call lets XLA reuse the buffers
+    # (measured ~9% on the headline phase, PERF.md)
     if mesh is not None:
         return make_device_dp_train_step(
-            model, opt, mesh, batch_size, keep_prob=0.75, chunk=chunk,
-            donate=False)
+            model, opt, mesh, batch_size, keep_prob=0.75, chunk=chunk)
     return make_device_train_step(
-        model, opt, batch_size, keep_prob=0.75, chunk=chunk, donate=False)
+        model, opt, batch_size, keep_prob=0.75, chunk=chunk)
 
 
 def _timed_device_phase(ds, n_chips, model, opt, per_chip_batch: int,
@@ -186,7 +188,7 @@ def throughput_phase(ds, n_chips) -> float:
     from distributed_tensorflow_tpu.models import DeepCNN
     from distributed_tensorflow_tpu.training import adam
 
-    batch_size = PER_CHIP_BATCH * n_chips
+    batch_size = WIRE_BATCH * n_chips
     model = DeepCNN(compute_dtype=jnp.bfloat16)
     state, step_fn, stage = _build(model, adam(1e-3), n_chips)
 

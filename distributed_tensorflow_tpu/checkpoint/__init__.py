@@ -1,5 +1,6 @@
 from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     Checkpointer,
+    background_save_from_flags,
     save_checkpoint,
     restore_latest,
     latest_checkpoint,
@@ -7,6 +8,7 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import (
 
 __all__ = [
     "Checkpointer",
+    "background_save_from_flags",
     "save_checkpoint",
     "restore_latest",
     "latest_checkpoint",

@@ -120,6 +120,13 @@ def restore_latest(directory: str, template):
         raise KeyError(f"checkpoint {path}: {e}") from None
 
 
+def background_save_from_flags(FLAGS) -> bool:
+    """The one flag→feature mapping for ``--async_checkpoint`` (default
+    False for flag-less library callers), shared by every loop that builds
+    a Checkpointer so the modes cannot diverge."""
+    return bool(getattr(FLAGS, "async_checkpoint", False))
+
+
 class Checkpointer:
     """Time-cadenced, chief-only checkpointing (Supervisor parity).
 

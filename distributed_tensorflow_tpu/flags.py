@@ -135,7 +135,9 @@ def define_reference_flags():
     DEFINE_string("mode", "auto", "Parallel mode: auto|local|sync|ps. auto = "
                   "'ps' roles when --ps_hosts is set (reference semantics), "
                   "else sync DP over all local devices")
-    DEFINE_string("model", "deep_cnn", "Model architecture: deep_cnn|resnet20")
+    DEFINE_string("model", "deep_cnn", "Model architecture: "
+                  "deep_cnn|mlp|resnet20|resnet32 (mlp reads "
+                  "--hidden_units; the other models don't)")
     DEFINE_string("dataset", "mnist", "Dataset: mnist|fashion_mnist|cifar10")
     DEFINE_string("optimizer", "sgd", "Optimizer: sgd|momentum|adam (reference: sgd)")
     DEFINE_float("keep_prob", 0.75, "Dropout keep probability during training. "
@@ -186,6 +188,12 @@ def define_reference_flags():
                    "(0 = the full --training_iter budget)")
     DEFINE_float("decay_rate", 0.96, "Decay factor per --decay_steps for "
                  "--lr_schedule=exponential")
+    DEFINE_string("prng", "threefry", "PRNG implementation: threefry "
+                  "(default, partition-invariant) or rbg (hardware RNG — "
+                  "measured ~4% faster steps on TPU; dropout masks and "
+                  "on-device batch sampling draw from it). Checkpoints "
+                  "store the rng key, whose shape differs between "
+                  "implementations: resume with the same --prng")
     DEFINE_boolean("async_checkpoint", True, "Write cadenced checkpoints "
                    "from a background thread (the state is fetched to "
                    "host on the training thread, then serialized and "

@@ -1,0 +1,77 @@
+"""Single-hidden-layer MLP — the model the reference's dead flag was for.
+
+The reference defines ``--hidden_units=100`` ("Number of units in the
+hidden layer of the NN", ``/root/reference/.idea/MNISTDist.py:26``) and
+never reads it — the flag survives from the MLP this script evolved from.
+``--model mlp`` makes it live: flatten → dense(hidden_units) + relu →
+dropout → dense(num_classes), with the same init family as the CNN
+(truncated normal σ=0.1, bias 0.1, ``MNISTDist.py:42-49``).
+
+Same functional contract as the other models (pytree params + pure
+``apply``), so every mode — sync DP, device-resident sampling, PS
+emulation, checkpointing — works unchanged. No tensor-parallel sharding
+rule is registered (a 100-unit hidden layer has nothing worth splitting);
+``--model_axis>1`` is rejected loudly by the existing ``has_tp_specs``
+gate.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.models.cnn import (
+    constant_init,
+    truncated_normal_init,
+)
+from distributed_tensorflow_tpu.models.registry import register_model
+from distributed_tensorflow_tpu.ops import nn
+
+
+@register_model("mlp")
+class MLP:
+    def __init__(
+        self,
+        image_size: int = 28,
+        channels: int = 1,
+        num_classes: int = 10,
+        hidden_units: int = 100,
+        compute_dtype: Any = None,
+    ):
+        self.image_size = image_size
+        self.channels = channels
+        self.num_classes = num_classes
+        self.hidden_units = hidden_units
+        self.compute_dtype = compute_dtype
+        self.flat_dim = image_size * image_size * channels
+
+    def init(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        return {
+            "weights": {
+                "h1": truncated_normal_init(
+                    k1, (self.flat_dim, self.hidden_units), dtype=dtype),
+                "out": truncated_normal_init(
+                    k2, (self.hidden_units, self.num_classes), dtype=dtype),
+            },
+            "biases": {
+                "h1": constant_init((self.hidden_units,), dtype=dtype),
+                "out": constant_init((self.num_classes,), dtype=dtype),
+            },
+        }
+
+    def apply(self, params, x, *, keep_prob=1.0, rng=None, train: bool = False):
+        w, b = params["weights"], params["biases"]
+        cd = self.compute_dtype
+        x = nn.normalize_if_u8(x, cd)
+        x = x.reshape(-1, self.flat_dim)
+        x = jax.nn.relu(nn.dense(x, w["h1"], b["h1"], compute_dtype=cd))
+        x = nn.dropout(x, keep_prob, rng, deterministic=not train)
+        return nn.dense(x, w["out"], b["out"], compute_dtype=cd)
+
+    def num_params(self, params=None):
+        if params is None:
+            params = jax.eval_shape(lambda: self.init(jax.random.key(0)))
+        return sum(int(jnp.size(p)) for p in jax.tree.leaves(params))

@@ -516,10 +516,11 @@ def run_worker(cluster, FLAGS) -> int:
     flat_template = flatten_params(template)
     assignment = assign_shards(list(flat_template), cluster.num_tasks("ps"))
 
+    from distributed_tensorflow_tpu.checkpoint import background_save_from_flags
+
     ckpt = Checkpointer(FLAGS.logdir, is_chief=is_chief,
                         save_model_secs=FLAGS.save_model_secs,
-                        background=bool(getattr(FLAGS, "async_checkpoint",
-                                                False)))
+                        background=background_save_from_flags(FLAGS))
     if is_chief:
         restored = ckpt.restore({"params": template, "step": 0})
         if restored is not None:
@@ -555,30 +556,34 @@ def run_worker(cluster, FLAGS) -> int:
     if FLAGS.shard_data:
         train_data = ds.train.shard(FLAGS.task_index, cluster.num_tasks("worker"))
 
-    step = client.get_step()
-    while step < FLAGS.training_iter:
-        batch = train_data.next_batch(FLAGS.batch_size)
-        flat, pull_step = client.pull_all()
-        step = pull_step
-        params = unflatten_params(template, flat)
-        if step % FLAGS.display_step == 0:
-            m = eval_fn(params, batch)
-            logger.log_display(step, float(m["loss"]), float(m["accuracy"]))
-        rng, sub = jax.random.split(rng)
-        grads, _ = grad_fn(params, batch, sub)
-        step = client.push_grads(flatten_params(grads), assignment)
-        # checkpoint the pulled snapshot under the step it corresponds to
-        # (pull_step), not the post-push counter
-        ckpt.maybe_save({"params": params, "step": pull_step}, pull_step)
+    try:
+        step = client.get_step()
+        while step < FLAGS.training_iter:
+            batch = train_data.next_batch(FLAGS.batch_size)
+            flat, pull_step = client.pull_all()
+            step = pull_step
+            params = unflatten_params(template, flat)
+            if step % FLAGS.display_step == 0:
+                m = eval_fn(params, batch)
+                logger.log_display(step, float(m["loss"]), float(m["accuracy"]))
+            rng, sub = jax.random.split(rng)
+            grads, _ = grad_fn(params, batch, sub)
+            step = client.push_grads(flatten_params(grads), assignment)
+            # checkpoint the pulled snapshot under the step it corresponds
+            # to (pull_step), not the post-push counter
+            ckpt.maybe_save({"params": params, "step": pull_step}, pull_step)
 
-    if is_chief:
-        flat, step = client.pull_all()
-        params = unflatten_params(template, flat)
-        ckpt.save({"params": params, "step": step}, step)
-        if FLAGS.test_eval:
-            res = evaluate(model, params, ds.test)
-            print("test accuracy: ", res["accuracy"], "test loss: ", res["loss"])
-    ckpt.close()
+        if is_chief:
+            flat, step = client.pull_all()
+            params = unflatten_params(template, flat)
+            ckpt.save({"params": params, "step": step}, step)
+            if FLAGS.test_eval:
+                res = evaluate(model, params, ds.test)
+                print("test accuracy: ", res["accuracy"], "test loss: ", res["loss"])
+    finally:
+        # drain the background writer even on a mid-run error (a pending
+        # cadenced save must not die with the process)
+        ckpt.close()
     print("Optimization Finished!")
     logger.close()
     return 0

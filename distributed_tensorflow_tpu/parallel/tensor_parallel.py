@@ -40,12 +40,21 @@ _CNN_TP_SPECS = {
 
 def tp_param_specs(params) -> dict:
     """PartitionSpec pytree mirroring ``params``: FC stack split over the
-    model axis, everything else replicated."""
+    model axis, everything else replicated. The split rule applies only
+    when the params carry the CNN's full FC stack (wd1 present) — a model
+    that merely shares a leaf NAME with the table (e.g. the MLP's "out")
+    must not have that one matmul split in isolation, which would buy a
+    collective and shard nothing that matters."""
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    all_keys = {
+        tuple(getattr(p, "key", getattr(p, "idx", None)) for p in path)
+        for path, _ in flat
+    }
+    table = _CNN_TP_SPECS if ("weights", "wd1") in all_keys else {}
     specs = {}
     for path, _ in flat:
         keys = tuple(getattr(p, "key", getattr(p, "idx", None)) for p in path)
-        specs[keys] = _CNN_TP_SPECS.get(keys, P())
+        specs[keys] = table.get(keys, P())
     # rebuild the nested dict shape
     out: dict = {}
     for keys, spec in specs.items():

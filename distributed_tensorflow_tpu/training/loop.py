@@ -24,6 +24,7 @@ from typing import Any
 
 import jax
 
+from distributed_tensorflow_tpu.checkpoint import background_save_from_flags
 from distributed_tensorflow_tpu.data import read_data_sets
 from distributed_tensorflow_tpu.data.pipeline import batch_iterator, prefetch_to_device
 from distributed_tensorflow_tpu.models import get_model
@@ -66,6 +67,11 @@ def build_model_for(FLAGS, meta: dict):
     kwargs = {}
     if FLAGS.model == "deep_cnn" and getattr(FLAGS, "pallas", False):
         kwargs["use_pallas"] = True
+    if FLAGS.model == "mlp":
+        # the one model where the reference's dead --hidden_units flag is
+        # live (models/mlp.py); deep_cnn keeps the reference's fixed 1024
+        # FC width (MNISTDist.py:83 — the flag was dead there too)
+        kwargs["hidden_units"] = FLAGS.hidden_units
     return get_model(
         FLAGS.model,
         image_size=meta["image_size"],
@@ -182,7 +188,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         is_chief=(FLAGS.task_index == 0),
         logdir=FLAGS.logdir,
         save_model_secs=FLAGS.save_model_secs,
-        background_save=bool(getattr(FLAGS, "async_checkpoint", False)),
+        background_save=background_save_from_flags(FLAGS),
     )
     logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
                            job_name=FLAGS.job_name or "worker",
@@ -340,7 +346,7 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
         is_chief=(FLAGS.task_index == 0),
         logdir=FLAGS.logdir,
         save_model_secs=FLAGS.save_model_secs,
-        background_save=bool(getattr(FLAGS, "async_checkpoint", False)),
+        background_save=background_save_from_flags(FLAGS),
     )
     logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
                            job_name=FLAGS.job_name or "worker",

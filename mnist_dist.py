@@ -34,6 +34,12 @@ FLAGS = flags.FLAGS
 
 
 def main(_):
+    if FLAGS.prng != "threefry":
+        # must land before any PRNG key is created; affects dropout masks
+        # and --device_data's on-device batch sampling
+        import jax
+
+        jax.config.update("jax_default_prng_impl", FLAGS.prng)
     mode = resolve_mode(FLAGS)
 
     if mode == "ps":
