@@ -151,6 +151,10 @@ def define_reference_flags():
                    "dominant FC layer (deep_cnn only)")
     DEFINE_boolean("test_eval", True, "Evaluate on the test split at the end "
                    "(the reference never does; targets require it)")
+    DEFINE_integer("eval_step", 0, "If > 0, also evaluate on the FULL test "
+                   "split every this many steps (logged as test_accuracy/"
+                   "test_loss scalars). 0 = end-of-run only; the reference "
+                   "never touches the test split at all")
     DEFINE_boolean("shard_data", False, "Give each worker a disjoint data shard "
                    "(reference: every worker samples the full dataset)")
     DEFINE_string("profile_dir", "", "If set, capture a jax.profiler trace of "

@@ -195,12 +195,14 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                            task_index=FLAGS.task_index)
     meter = Throughput(FLAGS.batch_size, n_chips)
     last_display = {}
+    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
 
     should_stop = _voting_should_stop(sv) if (mode == "sync" and n_procs > 1) \
         else sv.should_stop
 
     with sv.managed(state) as box:
         state, step = box.state, box.step
+        periodic_eval.prime(step)
         if restage is not None:
             # a restored checkpoint arrives as host arrays; re-place it on
             # the mesh layout (no-op when the state is already placed)
@@ -247,6 +249,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                     jax.profiler.stop_trace()
                     profiling = False
                     profile_done = True
+                periodic_eval(state, step)
                 box.update(state, step)
                 sv.maybe_checkpoint(state, step)
             jax.block_until_ready(state.params)
@@ -255,14 +258,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 jax.profiler.stop_trace()
             batches.close()
 
-    test_metrics = None
-    if FLAGS.test_eval:
-        test_metrics = evaluate(model, jax.device_get(state.params), ds.test,
-                                model_state=jax.device_get(state.model_state))
-        print("test accuracy: ", test_metrics["accuracy"],
-              "test loss: ", test_metrics["loss"])
-        logger.scalars(step, {"test_accuracy": test_metrics["accuracy"],
-                              "test_loss": test_metrics["loss"]})
+    test_metrics = _final_test_eval(FLAGS, periodic_eval, model, state, ds,
+                                    logger, step)
     print("Optimization Finished!")
     logger.close()
     return TrainResult(
@@ -273,6 +270,62 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         images_per_sec_per_chip=meter.images_per_sec_per_chip,
         n_chips=n_chips,
     )
+
+
+def _periodic_test_eval(FLAGS, sv, model, ds, logger):
+    """(state, step) -> None: full test-split evaluation every
+    ``--eval_step`` steps (crossing semantics, so chunked loops that jump
+    several steps per dispatch still evaluate once per boundary). Chief
+    only — it is host-side work off the compiled path; the reference never
+    evaluates on the test split at all (SURVEY.md §5 metrics), the north
+    star requires it."""
+    every = getattr(FLAGS, "eval_step", 0)
+    if not every or every <= 0 or not sv.is_chief:
+        noop = lambda state, step: None
+        noop.prime = lambda step: None
+        noop.last_result = lambda: None
+        return noop
+    state_box = {"done": 0, "last": None}
+
+    def maybe_eval(state, step: int):
+        if step // every <= state_box["done"]:
+            return
+        state_box["done"] = step // every
+        m = evaluate(model, jax.device_get(state.params), ds.test,
+                     model_state=jax.device_get(state.model_state))
+        state_box["last"] = (step, m)
+        print(f"step: {step} test accuracy: {m['accuracy']} "
+              f"test loss: {m['loss']}")
+        logger.scalars(step, {"test_accuracy": m["accuracy"],
+                              "test_loss": m["loss"]})
+
+    def prime(step: int):
+        # a resumed run starts counting boundaries from the restored step
+        state_box["done"] = step // every
+
+    maybe_eval.prime = prime
+    # lets the end-of-run eval reuse a result computed at the final step
+    # instead of re-running the full split and double-logging it
+    maybe_eval.last_result = lambda: state_box["last"]
+    return maybe_eval
+
+
+def _final_test_eval(FLAGS, periodic_eval, model, state, ds, logger, step):
+    """End-of-run test evaluation (both loops): reuses the periodic eval's
+    result when it already covered the final step."""
+    if not FLAGS.test_eval:
+        return None
+    last = periodic_eval.last_result()
+    if last is not None and last[0] == step:
+        test_metrics = last[1]  # scalars already logged at this step
+    else:
+        test_metrics = evaluate(model, jax.device_get(state.params), ds.test,
+                                model_state=jax.device_get(state.model_state))
+        logger.scalars(step, {"test_accuracy": test_metrics["accuracy"],
+                              "test_loss": test_metrics["loss"]})
+    print("test accuracy: ", test_metrics["accuracy"],
+          "test loss: ", test_metrics["loss"])
+    return test_metrics
 
 
 def _voting_should_stop(sv):
@@ -353,6 +406,7 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                            task_index=FLAGS.task_index)
     meter = Throughput(FLAGS.batch_size, n_chips)
     last_display = {}
+    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
     sync_every = collective_sync_cadence(mesh is not None)
     chunks_done = 0
 
@@ -361,6 +415,7 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
 
     with sv.managed(state) as box:
         state, step = box.state, box.step
+        periodic_eval.prime(step)
         if restage is not None:
             # a restored checkpoint arrives as host arrays; re-place it on
             # the TP mesh layout (no-op for a freshly placed state)
@@ -405,20 +460,15 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                 jax.profiler.stop_trace()
                 profiling = False
                 profile_done = True
+            periodic_eval(state, step)
             box.update(state, step)
             sv.maybe_checkpoint(state, step)
         jax.block_until_ready(state.params)
         if profiling:
             jax.profiler.stop_trace()
 
-    test_metrics = None
-    if FLAGS.test_eval:
-        test_metrics = evaluate(model, jax.device_get(state.params), ds.test,
-                                model_state=jax.device_get(state.model_state))
-        print("test accuracy: ", test_metrics["accuracy"],
-              "test loss: ", test_metrics["loss"])
-        logger.scalars(step, {"test_accuracy": test_metrics["accuracy"],
-                              "test_loss": test_metrics["loss"]})
+    test_metrics = _final_test_eval(FLAGS, periodic_eval, model, state, ds,
+                                    logger, step)
     print("Optimization Finished!")
     logger.close()
     return TrainResult(
