@@ -192,6 +192,13 @@ def define_reference_flags():
                    "(0 = the full --training_iter budget)")
     DEFINE_float("decay_rate", 0.96, "Decay factor per --decay_steps for "
                  "--lr_schedule=exponential")
+    DEFINE_integer("accum_steps", 1, "Gradient accumulation: split each "
+                   "batch into this many equal microbatches, one backward "
+                   "pass each (lax.scan — live activations are one "
+                   "microbatch's worth), average, then a single optimizer "
+                   "update. local/sync/TP modes; incompatible with "
+                   "--device_data (whose batches are already sampled "
+                   "on device per step)")
     DEFINE_string("prng", "threefry", "PRNG implementation: threefry "
                   "(default, partition-invariant) or rbg (hardware RNG — "
                   "measured ~4% faster steps on TPU; dropout masks and "

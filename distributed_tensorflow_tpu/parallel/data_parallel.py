@@ -25,6 +25,7 @@ from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, batch_sharding, 
 from distributed_tensorflow_tpu.training.train_state import (
     TrainState,
     apply_updates,
+    compute_grads,
     loss_and_metrics,
 )
 
@@ -71,7 +72,7 @@ def local_batch_size(global_batch_size: int) -> int:
 
 
 def make_dp_train_step(model, optimizer, mesh, keep_prob: float = 1.0, donate: bool = True,
-                       grad_transform=None):
+                       grad_transform=None, accum_steps: int = 1):
     """Compiled sync-DP train step: (state, sharded batch) -> (state, metrics).
 
     Per-shard: forward+backward on the local batch slice with a
@@ -80,27 +81,25 @@ def make_dp_train_step(model, optimizer, mesh, keep_prob: float = 1.0, donate: b
     replicated state stays bitwise in sync (the property the reference
     gives up by going async). ``grad_transform`` (e.g. global-norm clip)
     runs on the aggregated grads, identically on every shard.
+    ``accum_steps`` accumulates gradients over that many microbatches of
+    the shard's slice before the one pmean+update
+    (``train_state.compute_grads``).
     """
-
     def per_shard(state: TrainState, batch):
         rng, sub = jax.random.split(state.rng)
         # distinct dropout mask per data shard, same key evolution everywhere
         sub = jax.random.fold_in(sub, lax.axis_index(DATA_AXIS))
 
-        def loss_fn(params):
-            return loss_and_metrics(
-                model, params, batch, keep_prob=keep_prob, rng=sub, train=True,
-                model_state=state.model_state,
-            )
-
-        grads, aux = jax.grad(loss_fn, has_aux=True)(state.params)
+        grads, shard_metrics, model_state = compute_grads(
+            model, state.params, batch, keep_prob=keep_prob, rng=sub,
+            model_state=state.model_state, accum_steps=accum_steps,
+        )
         grads = lax.pmean(grads, DATA_AXIS)
         if grad_transform is not None:
             grads = grad_transform(grads)
-        metrics = lax.pmean(aux["metrics"], DATA_AXIS)
+        metrics = lax.pmean(shard_metrics, DATA_AXIS)
         # cross-replica batch-norm stats: average the per-shard EMAs so the
         # replicated state stays identical on every device
-        model_state = aux["model_state"]
         if model_state:
             model_state = lax.pmean(model_state, DATA_AXIS)
         updates, opt_state = optimizer.update(grads, state.opt_state,

@@ -504,6 +504,12 @@ def run_worker(cluster, FLAGS) -> int:
             "the parameter server applies a fixed learning rate. Use "
             "sync/local mode for scheduled learning rates."
         )
+    if getattr(FLAGS, "accum_steps", 1) > 1:
+        raise ValueError(
+            "--accum_steps is not supported in ps mode (the reference's "
+            "cycle pushes one batch's gradients per pull); use sync/local "
+            "mode"
+        )
     ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
                         seed=FLAGS.seed + FLAGS.task_index)
     model = build_model_for(FLAGS, ds.meta)

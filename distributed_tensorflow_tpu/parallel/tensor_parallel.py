@@ -139,7 +139,8 @@ def shard_state_tp(state: TrainState, mesh: Mesh) -> TrainState:
 
 
 def make_tp_train_step(model, optimizer, mesh: Mesh, keep_prob: float = 1.0,
-                       donate: bool = True, grad_transform=None):
+                       donate: bool = True, grad_transform=None,
+                       accum_steps: int = 1):
     """Compiled TP(+DP) train step: (state, batch) -> (state, metrics).
 
     This IS ``make_train_step``: under GSPMD the program is global-view and
@@ -154,7 +155,8 @@ def make_tp_train_step(model, optimizer, mesh: Mesh, keep_prob: float = 1.0,
     from distributed_tensorflow_tpu.training.train_state import make_train_step
 
     return make_train_step(model, optimizer, keep_prob=keep_prob,
-                           grad_transform=grad_transform, donate=donate)
+                           grad_transform=grad_transform, donate=donate,
+                           accum_steps=accum_steps)
 
 
 def make_tp_eval_step(model):

@@ -118,6 +118,20 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         )
 
         clip = clip_by_global_norm(FLAGS.clip_norm)
+    accum = max(1, getattr(FLAGS, "accum_steps", 1))
+    if accum > 1:
+        if getattr(FLAGS, "device_data", False):
+            raise ValueError(
+                "--accum_steps>1 is incompatible with --device_data: the "
+                "device-resident step samples its batch on device each "
+                "step, so there is no host batch to split; raise "
+                "--batch_size instead"
+            )
+        if FLAGS.batch_size % accum:
+            raise ValueError(
+                f"--batch_size={FLAGS.batch_size} must be divisible by "
+                f"--accum_steps={accum}"
+            )
     if mode == "sync" and model_axis > 1:
         # tensor parallelism (+DP on the remaining devices): GSPMD layout,
         # XLA inserts the collectives — parallel/tensor_parallel.py
@@ -146,10 +160,16 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 f"--batch_size={FLAGS.batch_size} must be divisible by the "
                 f"{data_ways}-way data axis"
             )
+        if accum > 1 and (FLAGS.batch_size // accum) % data_ways:
+            raise ValueError(
+                f"each of the {accum} microbatches "
+                f"({FLAGS.batch_size // accum} examples) must split over "
+                f"the {data_ways}-way data axis"
+            )
         feed_batch = local_batch_size(FLAGS.batch_size)
         state = shard_state_tp(state, mesh)
         step_fn = make_tp_train_step(model, opt, mesh, keep_prob=FLAGS.keep_prob,
-                                     grad_transform=clip)
+                                     grad_transform=clip, accum_steps=accum)
         eval_fn = make_tp_eval_step(model)
         stage = lambda b: stage_batch_tp(mesh, b)
         restage = lambda s: shard_state_tp(s, mesh)
@@ -161,15 +181,21 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 f"--batch_size={FLAGS.batch_size} must be divisible by the "
                 f"{n_chips} devices in the data mesh"
             )
+        if accum > 1 and (FLAGS.batch_size // n_chips) % accum:
+            raise ValueError(
+                f"each device's batch slice "
+                f"({FLAGS.batch_size // n_chips} examples) must split into "
+                f"{accum} equal microbatches"
+            )
         feed_batch = local_batch_size(FLAGS.batch_size)
         state = replicate_state(mesh, state)
         step_fn = make_dp_train_step(model, opt, mesh, keep_prob=FLAGS.keep_prob,
-                                     grad_transform=clip)
+                                     grad_transform=clip, accum_steps=accum)
         eval_fn = make_dp_eval_step(model, mesh)
         stage = lambda b: shard_batch(mesh, b)
     else:
         step_fn = make_train_step(model, opt, keep_prob=FLAGS.keep_prob,
-                                  grad_transform=clip)
+                                  grad_transform=clip, accum_steps=accum)
         eval_fn = make_eval_step(model)
         stage = None  # prefetch default: device_put to the default device
 

@@ -19,6 +19,7 @@ from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from distributed_tensorflow_tpu.ops import nn
 
@@ -201,6 +202,66 @@ def loss_and_metrics(model, params, batch, *, keep_prob=1.0, rng=None,
                   "model_state": new_state}
 
 
+def compute_grads(model, params, batch, *, keep_prob, rng, model_state,
+                  accum_steps: int = 1):
+    """(grads, metrics, new_model_state) for one optimizer update.
+
+    ``accum_steps > 1`` is gradient accumulation: the batch is split into
+    that many equal microbatches, ``lax.scan`` runs one backward pass per
+    microbatch (so live activation memory is one microbatch's worth — the
+    point of accumulation), gradients and metrics are averaged (equal
+    microbatch sizes make the mean of means the full-batch mean), and a
+    stateful model's state threads sequentially through the microbatches.
+    Dropout draws a distinct key per microbatch. Not in the reference
+    (single-batch SGD, MNISTDist.py:149,188); standard large-batch
+    machinery."""
+
+    def loss_for(p, b, key, ms):
+        return loss_and_metrics(model, p, b, keep_prob=keep_prob, rng=key,
+                                train=True, model_state=ms)
+
+    if accum_steps <= 1:
+        grads, aux = jax.grad(loss_for, has_aux=True)(
+            params, batch, rng, model_state)
+        return grads, aux["metrics"], aux["model_state"]
+
+    x, y = batch
+    n = x.shape[0]
+    if n % accum_steps:
+        raise ValueError(
+            f"batch of {n} examples does not split into "
+            f"{accum_steps} equal microbatches"
+        )
+    micro = n // accum_steps
+    xm = x.reshape(accum_steps, micro, *x.shape[1:])
+    ym = y.reshape(accum_steps, micro, *y.shape[1:])
+
+    def body(carry, inp):
+        g_acc, m_acc, ms = carry
+        i, xb, yb = inp
+        key = None if rng is None else jax.random.fold_in(rng, i)
+        g, aux = jax.grad(loss_for, has_aux=True)(params, (xb, yb), key, ms)
+        g_acc = jax.tree.map(jnp.add, g_acc, g)
+        m_acc = jax.tree.map(jnp.add, m_acc, aux["metrics"])
+        return (g_acc, m_acc, aux["model_state"]), None
+
+    g0 = jax.tree.map(jnp.zeros_like, params)
+    # derive the metrics carry from loss_and_metrics itself so this stays
+    # in lockstep if it ever gains a key or changes a dtype
+    _, aux_shape = jax.eval_shape(loss_for, params, (xm[0], ym[0]), rng,
+                                  model_state)
+    m0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                      aux_shape["metrics"])
+    (g_sum, m_sum, model_state), _ = lax.scan(
+        body, (g0, m0, model_state),
+        (jnp.arange(accum_steps), xm, ym),
+    )
+    inv = 1.0 / accum_steps
+    grads = jax.tree.map(lambda g: g * inv, g_sum)
+    metrics = jax.tree.map(lambda m: m * inv, m_sum)
+    return grads, metrics, model_state
+
+
 def make_train_step(
     model,
     optimizer: Optimizer,
@@ -208,6 +269,7 @@ def make_train_step(
     grad_transform: Callable[[Any], Any] | None = None,
     metrics_transform: Callable[[Any], Any] | None = None,
     donate: bool = True,
+    accum_steps: int = 1,
 ):
     """Build the compiled train step: (state, batch) -> (state, metrics).
 
@@ -217,19 +279,16 @@ def make_train_step(
     ``metrics_transform`` is the separate hook for aggregating the metrics
     dict across shards (``pmean``); it must NOT be a sum-collective or a
     clipping transform, which would corrupt reported loss/accuracy.
+    ``accum_steps`` splits the batch into microbatches and accumulates
+    gradients before the single optimizer update (``compute_grads``).
     """
 
     def step_fn(state: TrainState, batch):
         rng, sub = jax.random.split(state.rng)
-
-        def loss_fn(params):
-            return loss_and_metrics(
-                model, params, batch, keep_prob=keep_prob, rng=sub, train=True,
-                model_state=state.model_state,
-            )
-
-        grads, aux = jax.grad(loss_fn, has_aux=True)(state.params)
-        metrics, model_state = aux["metrics"], aux["model_state"]
+        grads, metrics, model_state = compute_grads(
+            model, state.params, batch, keep_prob=keep_prob, rng=sub,
+            model_state=state.model_state, accum_steps=accum_steps,
+        )
         if grad_transform is not None:
             grads = grad_transform(grads)
         if metrics_transform is not None:

@@ -56,6 +56,11 @@ def main(_):
                   "mode (the ps applies a fixed learning rate); use "
                   "sync/local mode", file=sys.stderr)
             return 2
+        if FLAGS.accum_steps > 1:
+            print("--accum_steps is not supported in ps mode (one batch's "
+                  "gradients per pull/push cycle); use sync/local mode",
+                  file=sys.stderr)
+            return 2
         from distributed_tensorflow_tpu.parallel import ps_emulation
 
         if FLAGS.job_name == "ps":

@@ -1,0 +1,148 @@
+"""Gradient accumulation: K-microbatch accumulation must equal the direct
+full-batch step (same update, same metrics), across local, DP, and TP
+step builders; plus the mode guards."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.synthetic import synthetic_digits
+from distributed_tensorflow_tpu.models import DeepCNN
+from distributed_tensorflow_tpu.training import (
+    create_train_state,
+    make_train_step,
+    sgd,
+)
+from distributed_tensorflow_tpu.training.train_state import compute_grads
+
+
+def _batch(n=32, seed=0):
+    xs, labels = synthetic_digits(n, seed=seed)
+    return jnp.asarray(xs), jax.nn.one_hot(jnp.asarray(labels), 10)
+
+
+def _allclose_tree(a, b, **kw):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), **kw)
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_accum_grads_equal_direct(k):
+    """Mean of microbatch grads == full-batch grads (keep_prob=1 so
+    dropout cannot differ)."""
+    model = DeepCNN()
+    params = model.init(jax.random.PRNGKey(0))
+    batch = _batch(32)
+    g1, m1, _ = compute_grads(model, params, batch, keep_prob=1.0,
+                              rng=None, model_state=(), accum_steps=1)
+    gk, mk, _ = compute_grads(model, params, batch, keep_prob=1.0,
+                              rng=None, model_state=(), accum_steps=k)
+    # f32 summation-order noise only: elements near zero show ~1e-4
+    # relative at ~3e-7 absolute
+    _allclose_tree(g1, gk, rtol=2e-4, atol=1e-6)
+    assert float(m1["loss"]) == pytest.approx(float(mk["loss"]), rel=1e-5)
+    assert float(m1["accuracy"]) == pytest.approx(float(mk["accuracy"]),
+                                                  rel=1e-6)
+
+
+def test_accum_step_equals_direct_step():
+    model = DeepCNN()
+    opt = sgd(0.05)
+    batch = _batch(32)
+    s_direct = create_train_state(model, opt, seed=0)
+    s_accum = create_train_state(model, opt, seed=0)
+    direct = make_train_step(model, opt, keep_prob=1.0, donate=False)
+    accum = make_train_step(model, opt, keep_prob=1.0, donate=False,
+                            accum_steps=4)
+    s_direct, _ = direct(s_direct, batch)
+    s_accum, _ = accum(s_accum, batch)
+    assert int(s_accum.step) == 1  # ONE update for K microbatches
+    _allclose_tree(s_direct.params, s_accum.params, rtol=2e-5, atol=1e-7)
+
+
+def test_accum_indivisible_batch_is_loud():
+    model = DeepCNN()
+    params = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="does not split"):
+        compute_grads(model, params, _batch(30), keep_prob=1.0, rng=None,
+                      model_state=(), accum_steps=4)
+
+
+def test_accum_dp_equals_direct_dp():
+    from distributed_tensorflow_tpu.parallel import (
+        MeshSpec,
+        make_dp_train_step,
+        make_mesh,
+        shard_batch,
+    )
+    from distributed_tensorflow_tpu.parallel.data_parallel import replicate_state
+
+    mesh = make_mesh(MeshSpec(data=8, model=1))
+    model = DeepCNN()
+    opt = sgd(0.05)
+    batch = shard_batch(mesh, _batch(64))
+    s_direct = replicate_state(mesh, create_train_state(model, opt, seed=0))
+    s_accum = replicate_state(mesh, create_train_state(model, opt, seed=0))
+    direct = make_dp_train_step(model, opt, mesh, keep_prob=1.0, donate=False)
+    accum = make_dp_train_step(model, opt, mesh, keep_prob=1.0, donate=False,
+                               accum_steps=2)
+    s_direct, m1 = direct(s_direct, batch)
+    s_accum, mk = accum(s_accum, batch)
+    _allclose_tree(s_direct.params, s_accum.params, rtol=2e-5, atol=1e-7)
+    assert float(m1["loss"]) == pytest.approx(float(mk["loss"]), rel=1e-5)
+
+
+def test_accum_tp_runs():
+    from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
+    from distributed_tensorflow_tpu.parallel.tensor_parallel import (
+        make_tp_train_step,
+        shard_state_tp,
+        stage_batch_tp,
+    )
+
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    model = DeepCNN()
+    opt = sgd(0.05)
+    state = shard_state_tp(create_train_state(model, opt, seed=0), mesh)
+    step = make_tp_train_step(model, opt, mesh, keep_prob=1.0, donate=False,
+                              accum_steps=2)
+    state, m = step(state, stage_batch_tp(mesh, _batch(32)))
+    assert int(state.step) == 1
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_accum_stateful_model_threads_state():
+    """Batch-norm state threads through the microbatches sequentially."""
+    from distributed_tensorflow_tpu.models import get_model
+
+    model = get_model("resnet20", image_size=8, channels=3, num_classes=10)
+    opt = sgd(0.05)
+    state = create_train_state(model, opt, seed=0)
+    step = make_train_step(model, opt, keep_prob=1.0, donate=False,
+                           accum_steps=2)
+    x = jax.random.normal(jax.random.key(0), (8, 8 * 8 * 3))
+    y = jax.nn.one_hot(jnp.arange(8) % 10, 10)
+    before = jax.tree.leaves(state.model_state)[0].copy()
+    state, m = step(state, (x, y))
+    after = jax.tree.leaves(state.model_state)[0]
+    assert np.isfinite(float(m["loss"]))
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+
+
+def test_accum_rejected_with_device_data(tmp_path):
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    flags.FLAGS._reset()
+    try:
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+            "--training_iter=2", "--batch_size=32",
+            "--accum_steps=2", "--device_data",
+        ])
+        with pytest.raises(ValueError, match="incompatible with --device_data"):
+            train(flags.FLAGS, mode="local")
+    finally:
+        flags.FLAGS._reset()
