@@ -192,6 +192,12 @@ def define_reference_flags():
                    "(0 = the full --training_iter budget)")
     DEFINE_float("decay_rate", 0.96, "Decay factor per --decay_steps for "
                  "--lr_schedule=exponential")
+    DEFINE_boolean("augment", False, "On-device data augmentation compiled "
+                   "into the train step: zero-pad by --augment_pad, random "
+                   "crop back, and — for 3-channel natural images only — "
+                   "random horizontal flip (digits are never mirrored). "
+                   "Zero host cost. local/sync/TP and --device_data modes")
+    DEFINE_integer("augment_pad", 4, "Padding for --augment's random crop")
     DEFINE_integer("accum_steps", 1, "Gradient accumulation: split each "
                    "batch into this many equal microbatches, one backward "
                    "pass each (lax.scan — live activations are one "

@@ -1,0 +1,63 @@
+"""On-device data augmentation, compiled into the train step.
+
+The reference has no augmentation (raw MNIST batches straight into the
+feed_dict, ``MNISTDist.py:178-188``); host-side augmentation is also the
+classic input-pipeline bottleneck. The TPU-native design runs it INSIDE
+the compiled step — a PRNG key in, pure array ops out, fused by XLA with
+the first conv — so it is free of host cost, works identically in the
+host-fed and device-resident (``--device_data``) modes, and each data
+shard draws independent augmentations from its own key stream.
+
+The transform is the standard CIFAR recipe: zero-pad by ``pad``, random
+crop back to the original size, random horizontal flip — applied
+per-example via one gather (no ``vmap`` of ``dynamic_slice``, which XLA
+would turn into a serial loop on TPU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def random_crop_flip(images, rng, *, pad: int = 4, flip: bool = True):
+    """Per-example random crop (after zero-padding) + horizontal flip.
+
+    ``images``: [B, H, W, C], any real dtype (uint8 passes through
+    unchanged in dtype). Returns the same shape/dtype.
+    """
+    b, h, w, c = images.shape
+    kc, kf = jax.random.split(rng)
+    padded = jnp.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+
+    # per-example crop offsets in [0, 2*pad]
+    off = jax.random.randint(kc, (b, 2), 0, 2 * pad + 1)
+    rows = off[:, 0, None] + jnp.arange(h)[None, :]  # [B, H]
+    cols = off[:, 1, None] + jnp.arange(w)[None, :]  # [B, W]
+    # advanced-index gather: out[b,i,j,:] = padded[b, rows[b,i], cols[b,j], :]
+    bidx = jnp.arange(b)[:, None, None]
+    out = padded[bidx, rows[:, :, None], cols[:, None, :]]
+
+    if flip:
+        do = jax.random.bernoulli(kf, 0.5, (b,))
+        out = jnp.where(do[:, None, None, None], out[:, :, ::-1, :], out)
+    return out
+
+
+def make_augment(meta: dict, *, pad: int = 4, flip: bool = True):
+    """(flat_or_nhwc_batch_images, rng) -> augmented, same layout.
+
+    Models in this framework take flattened [B, H*W*C] pixels
+    (``MNISTDist.py:68`` reshapes on entry); the augmenter restores the
+    image geometry from the dataset ``meta``, transforms, and re-flattens
+    so it drops in front of any model unchanged."""
+    h = w = meta["image_size"]
+    c = meta["channels"]
+
+    def augment(x, rng):
+        flat = x.ndim == 2
+        imgs = x.reshape(-1, h, w, c) if flat else x
+        imgs = random_crop_flip(imgs, rng, pad=pad, flip=flip)
+        return imgs.reshape(x.shape[0], -1) if flat else imgs
+
+    return augment

@@ -24,6 +24,7 @@ from jax.sharding import PartitionSpec as P
 from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, batch_sharding, replicated_sharding
 from distributed_tensorflow_tpu.training.train_state import (
     TrainState,
+    apply_augment,
     apply_updates,
     compute_grads,
     loss_and_metrics,
@@ -72,7 +73,8 @@ def local_batch_size(global_batch_size: int) -> int:
 
 
 def make_dp_train_step(model, optimizer, mesh, keep_prob: float = 1.0, donate: bool = True,
-                       grad_transform=None, accum_steps: int = 1):
+                       grad_transform=None, accum_steps: int = 1,
+                       augment_fn=None):
     """Compiled sync-DP train step: (state, sharded batch) -> (state, metrics).
 
     Per-shard: forward+backward on the local batch slice with a
@@ -89,6 +91,8 @@ def make_dp_train_step(model, optimizer, mesh, keep_prob: float = 1.0, donate: b
         rng, sub = jax.random.split(state.rng)
         # distinct dropout mask per data shard, same key evolution everywhere
         sub = jax.random.fold_in(sub, lax.axis_index(DATA_AXIS))
+        batch = apply_augment(augment_fn, batch, state.rng,
+                              shard_index=lax.axis_index(DATA_AXIS))
 
         grads, shard_metrics, model_state = compute_grads(
             model, state.params, batch, keep_prob=keep_prob, rng=sub,

@@ -140,7 +140,7 @@ def shard_state_tp(state: TrainState, mesh: Mesh) -> TrainState:
 
 def make_tp_train_step(model, optimizer, mesh: Mesh, keep_prob: float = 1.0,
                        donate: bool = True, grad_transform=None,
-                       accum_steps: int = 1):
+                       accum_steps: int = 1, augment_fn=None):
     """Compiled TP(+DP) train step: (state, batch) -> (state, metrics).
 
     This IS ``make_train_step``: under GSPMD the program is global-view and
@@ -156,7 +156,7 @@ def make_tp_train_step(model, optimizer, mesh: Mesh, keep_prob: float = 1.0,
 
     return make_train_step(model, optimizer, keep_prob=keep_prob,
                            grad_transform=grad_transform, donate=donate,
-                           accum_steps=accum_steps)
+                           accum_steps=accum_steps, augment_fn=augment_fn)
 
 
 def make_tp_eval_step(model):

@@ -25,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS
 from distributed_tensorflow_tpu.training.train_state import (
     TrainState,
+    apply_augment,
     apply_updates,
     loss_and_metrics,
 )
@@ -34,7 +35,7 @@ _SAMPLE_SALT = 0x5EED  # folds the sampling stream away from the dropout stream
 
 def _sampled_step_body(model, optimizer, batch_size: int, keep_prob: float,
                        axis: str | None, grad_transform=None,
-                       batch_sharding=None):
+                       batch_sharding=None, augment_fn=None):
     """(state, data) -> (state, metrics): one full train step — on-device
     batch sample, forward, backward, (pmean over ``axis`` if set), update.
     ``state.rng`` advances every step, so the sampling key (a salted fold of
@@ -51,6 +52,10 @@ def _sampled_step_body(model, optimizer, batch_size: int, keep_prob: float,
             sub = jax.random.fold_in(sub, lax.axis_index(axis))
         idx = jax.random.randint(samp, (batch_size,), 0, data.num_examples)
         batch = (data.images[idx], data.labels[idx])
+        if augment_fn is not None:
+            # samp is already per-shard (axis fold above), so the salted
+            # augment stream decorrelates across shards too
+            batch = apply_augment(augment_fn, batch, samp)
         if batch_sharding is not None:
             batch = tuple(
                 lax.with_sharding_constraint(b, s)
@@ -91,18 +96,20 @@ def _scan_chunk(body, chunk: int):
 
 def make_device_train_step(model, optimizer, batch_size: int, *,
                            keep_prob: float = 1.0, chunk: int = 1,
-                           donate: bool = True, grad_transform=None):
+                           donate: bool = True, grad_transform=None,
+                           augment_fn=None):
     """Single-device chunked step: (state, DeviceData) -> (state, metrics);
     advances ``state.step`` by ``chunk``."""
     body = _sampled_step_body(model, optimizer, batch_size, keep_prob, None,
-                              grad_transform)
+                              grad_transform, augment_fn=augment_fn)
     fn = _scan_chunk(body, chunk)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 def make_device_dp_train_step(model, optimizer, mesh, batch_size: int, *,
                               keep_prob: float = 1.0, chunk: int = 1,
-                              donate: bool = True, grad_transform=None):
+                              donate: bool = True, grad_transform=None,
+                              augment_fn=None):
     """Sync-DP chunked step over ``mesh``: state replicated, the resident
     split replicated, each shard samples ``batch_size // n_data`` examples
     locally and grads ``pmean`` over ICI — the input side costs no
@@ -114,7 +121,8 @@ def make_device_dp_train_step(model, optimizer, mesh, batch_size: int, *,
             f"data axis"
         )
     body = _sampled_step_body(model, optimizer, batch_size // n_data,
-                              keep_prob, DATA_AXIS, grad_transform)
+                              keep_prob, DATA_AXIS, grad_transform,
+                              augment_fn=augment_fn)
     fn = jax.shard_map(
         _scan_chunk(body, chunk),
         mesh=mesh,
@@ -127,7 +135,8 @@ def make_device_dp_train_step(model, optimizer, mesh, batch_size: int, *,
 
 def make_device_tp_train_step(model, optimizer, mesh, batch_size: int, *,
                               keep_prob: float = 1.0, chunk: int = 1,
-                              donate: bool = True, grad_transform=None):
+                              donate: bool = True, grad_transform=None,
+                              augment_fn=None):
     """TP(+DP) chunked step over device-resident data: global-view GSPMD
     program — the state carries its TP layout (parallel/tensor_parallel),
     the split is replicated, the in-program sampled batch is constrained to
@@ -140,6 +149,7 @@ def make_device_tp_train_step(model, optimizer, mesh, batch_size: int, *,
         NamedSharding(mesh, P(DATA_AXIS)),        # int labels [B]
     )
     body = _sampled_step_body(model, optimizer, batch_size, keep_prob,
-                              None, grad_transform, batch_sharding)
+                              None, grad_transform, batch_sharding,
+                              augment_fn=augment_fn)
     fn = _scan_chunk(body, chunk)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())

@@ -118,6 +118,15 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         )
 
         clip = clip_by_global_norm(FLAGS.clip_norm)
+    augment = None
+    if getattr(FLAGS, "augment", False):
+        from distributed_tensorflow_tpu.ops.augment import make_augment
+
+        # flip only natural images (CIFAR): mirroring digits corrupts the
+        # label-signal ('3' has no valid mirror glyph)
+        augment = make_augment(ds.meta,
+                               pad=getattr(FLAGS, "augment_pad", 4),
+                               flip=ds.meta["channels"] == 3)
     accum = max(1, getattr(FLAGS, "accum_steps", 1))
     if accum > 1:
         if getattr(FLAGS, "device_data", False):
@@ -169,7 +178,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         feed_batch = local_batch_size(FLAGS.batch_size)
         state = shard_state_tp(state, mesh)
         step_fn = make_tp_train_step(model, opt, mesh, keep_prob=FLAGS.keep_prob,
-                                     grad_transform=clip, accum_steps=accum)
+                                     grad_transform=clip, accum_steps=accum,
+                                     augment_fn=augment)
         eval_fn = make_tp_eval_step(model)
         stage = lambda b: stage_batch_tp(mesh, b)
         restage = lambda s: shard_state_tp(s, mesh)
@@ -190,12 +200,14 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         feed_batch = local_batch_size(FLAGS.batch_size)
         state = replicate_state(mesh, state)
         step_fn = make_dp_train_step(model, opt, mesh, keep_prob=FLAGS.keep_prob,
-                                     grad_transform=clip, accum_steps=accum)
+                                     grad_transform=clip, accum_steps=accum,
+                                     augment_fn=augment)
         eval_fn = make_dp_eval_step(model, mesh)
         stage = lambda b: shard_batch(mesh, b)
     else:
         step_fn = make_train_step(model, opt, keep_prob=FLAGS.keep_prob,
-                                  grad_transform=clip, accum_steps=accum)
+                                  grad_transform=clip, accum_steps=accum,
+                                  augment_fn=augment)
         eval_fn = make_eval_step(model)
         stage = None  # prefetch default: device_put to the default device
 
@@ -208,7 +220,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             )
         return _train_device_resident(
             FLAGS, ds, model, opt, state, mesh, n_chips, eval_fn, stage, clip,
-            tp=(mode == "sync" and model_axis > 1), restage=restage)
+            tp=(mode == "sync" and model_axis > 1), restage=restage,
+            augment_fn=augment)
 
     sv = Supervisor(
         is_chief=(FLAGS.task_index == 0),
@@ -373,7 +386,8 @@ def _voting_should_stop(sv):
 
 def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                            eval_fn, stage, grad_transform=None,
-                           tp: bool = False, restage=None) -> TrainResult:
+                           tp: bool = False, restage=None,
+                           augment_fn=None) -> TrainResult:
     """--device_data training: the split resident in HBM, batches sampled on
     device, ``lax.scan`` chunks amortizing dispatch (training/device_step).
     Per training step NOTHING crosses the host boundary; per display step
@@ -402,16 +416,16 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
             return make_device_tp_train_step(
                 model, opt, mesh, FLAGS.batch_size,
                 keep_prob=FLAGS.keep_prob, chunk=length,
-                grad_transform=grad_transform)
+                grad_transform=grad_transform, augment_fn=augment_fn)
         if mesh is not None:
             return make_device_dp_train_step(
                 model, opt, mesh, FLAGS.batch_size,
                 keep_prob=FLAGS.keep_prob, chunk=length,
-                grad_transform=grad_transform)
+                grad_transform=grad_transform, augment_fn=augment_fn)
         return make_device_train_step(
             model, opt, FLAGS.batch_size,
             keep_prob=FLAGS.keep_prob, chunk=length,
-            grad_transform=grad_transform)
+            grad_transform=grad_transform, augment_fn=augment_fn)
 
     chunk_fns: dict[int, Any] = {}
 

@@ -262,6 +262,23 @@ def compute_grads(model, params, batch, *, keep_prob, rng, model_state,
     return grads, metrics, model_state
 
 
+_AUG_SALT = 0xA06  # folds the augmentation stream away from dropout's
+
+
+def apply_augment(augment_fn, batch, key_base, shard_index=None):
+    """Augment the images of ``batch`` with a key derived by salted fold —
+    the existing dropout/sampling key evolution is untouched, so enabling
+    augmentation does not perturb any other random stream. ``shard_index``
+    (a traced ``lax.axis_index``) decorrelates data shards."""
+    if augment_fn is None:
+        return batch
+    key = jax.random.fold_in(key_base, _AUG_SALT)
+    if shard_index is not None:
+        key = jax.random.fold_in(key, shard_index)
+    x, y = batch
+    return augment_fn(x, key), y
+
+
 def make_train_step(
     model,
     optimizer: Optimizer,
@@ -270,6 +287,7 @@ def make_train_step(
     metrics_transform: Callable[[Any], Any] | None = None,
     donate: bool = True,
     accum_steps: int = 1,
+    augment_fn: Callable | None = None,
 ):
     """Build the compiled train step: (state, batch) -> (state, metrics).
 
@@ -281,10 +299,13 @@ def make_train_step(
     clipping transform, which would corrupt reported loss/accuracy.
     ``accum_steps`` splits the batch into microbatches and accumulates
     gradients before the single optimizer update (``compute_grads``).
+    ``augment_fn`` ((images, rng) -> images, e.g. ``ops.augment``) runs
+    inside the compiled step before the forward pass — train only.
     """
 
     def step_fn(state: TrainState, batch):
         rng, sub = jax.random.split(state.rng)
+        batch = apply_augment(augment_fn, batch, state.rng)
         grads, metrics, model_state = compute_grads(
             model, state.params, batch, keep_prob=keep_prob, rng=sub,
             model_state=state.model_state, accum_steps=accum_steps,

@@ -1,0 +1,144 @@
+"""On-device augmentation (ops/augment.py): geometry, dtype and
+determinism of the crop+flip transform, and its wiring into the compiled
+steps (host-fed and device-resident)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.ops.augment import make_augment, random_crop_flip
+
+
+def _imgs(b=8, h=8, w=8, c=3, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.uniform(0, 1, (b, h, w, c)).astype(dtype))
+
+
+def test_shape_and_dtype_preserved():
+    for dtype in (np.float32, np.uint8):
+        x = (_imgs(dtype=np.float32) * 255).astype(dtype) if dtype == np.uint8 \
+            else _imgs()
+        y = random_crop_flip(x, jax.random.PRNGKey(0), pad=2)
+        assert y.shape == x.shape and y.dtype == x.dtype
+
+
+def test_pad0_noflip_is_identity():
+    x = _imgs()
+    y = random_crop_flip(x, jax.random.PRNGKey(0), pad=0, flip=False)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_deterministic_per_key():
+    x = _imgs()
+    a = random_crop_flip(x, jax.random.PRNGKey(7), pad=3)
+    b = random_crop_flip(x, jax.random.PRNGKey(7), pad=3)
+    c = random_crop_flip(x, jax.random.PRNGKey(8), pad=3)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_crops_are_translations():
+    """With flip off, each output row range must be a contiguous window of
+    the zero-padded input — check by matching every example against all
+    possible offsets."""
+    x = _imgs(b=4, h=6, w=6, c=1)
+    pad = 2
+    y = np.asarray(random_crop_flip(x, jax.random.PRNGKey(3), pad=pad,
+                                    flip=False))
+    padded = np.pad(np.asarray(x), ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    for i in range(x.shape[0]):
+        found = any(
+            np.array_equal(y[i], padded[i, r:r + 6, s:s + 6])
+            for r in range(2 * pad + 1) for s in range(2 * pad + 1)
+        )
+        assert found, f"example {i} is not a crop of its padded input"
+
+
+def test_flip_flips_some():
+    x = _imgs(b=64)
+    y = np.asarray(random_crop_flip(x, jax.random.PRNGKey(1), pad=0,
+                                    flip=True))
+    xf = np.asarray(x)[:, :, ::-1, :]
+    flipped = sum(np.array_equal(y[i], xf[i]) for i in range(64))
+    kept = sum(np.array_equal(y[i], np.asarray(x)[i]) for i in range(64))
+    assert flipped + kept == 64
+    assert 10 < flipped < 54  # ~Binomial(64, 0.5)
+
+
+def test_make_augment_flat_roundtrip():
+    meta = {"image_size": 8, "channels": 3}
+    aug = make_augment(meta, pad=0, flip=False)
+    x = _imgs().reshape(8, -1)
+    y = aug(x, jax.random.PRNGKey(0))
+    assert y.shape == x.shape
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_augmented_train_step_runs():
+    from distributed_tensorflow_tpu.models import get_model
+    from distributed_tensorflow_tpu.training import (
+        create_train_state,
+        make_train_step,
+        sgd,
+    )
+
+    meta = {"image_size": 8, "channels": 3}
+    model = get_model("resnet20", image_size=8, channels=3, num_classes=10)
+    opt = sgd(0.05)
+    state = create_train_state(model, opt, seed=0)
+    step = make_train_step(model, opt, keep_prob=1.0, donate=False,
+                           augment_fn=make_augment(meta))
+    x = jax.random.normal(jax.random.key(0), (8, 8 * 8 * 3))
+    y = jax.nn.one_hot(jnp.arange(8) % 10, 10)
+    state, m = step(state, (x, y))
+    assert int(state.step) == 1 and np.isfinite(float(m["loss"]))
+
+
+def test_augmented_device_step_runs():
+    from distributed_tensorflow_tpu.data.device_data import DeviceData
+    from distributed_tensorflow_tpu.models import DeepCNN
+    from distributed_tensorflow_tpu.training import create_train_state, sgd
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_device_train_step,
+    )
+
+    n = 64
+    data = DeviceData(
+        jnp.asarray((np.arange(n * 784) % 255).astype(np.uint8).reshape(n, 784)),
+        jnp.asarray((np.arange(n) % 10).astype(np.int32)),
+    )
+    model = DeepCNN()
+    opt = sgd(0.1)
+    state = create_train_state(model, opt, seed=0)
+    aug = make_augment({"image_size": 28, "channels": 1}, pad=2, flip=False)
+    fn = make_device_train_step(model, opt, 16, keep_prob=0.75, chunk=2,
+                                donate=False, augment_fn=aug)
+    state, m = fn(state, data)
+    assert int(state.step) == 2 and np.isfinite(float(m["loss"]))
+
+
+def test_augment_does_not_perturb_other_streams():
+    """Enabling augmentation must not change the dropout/sampling key
+    evolution: the post-step state.rng is identical with and without."""
+    from distributed_tensorflow_tpu.models import DeepCNN
+    from distributed_tensorflow_tpu.training import (
+        create_train_state,
+        make_train_step,
+        sgd,
+    )
+
+    model = DeepCNN()
+    opt = sgd(0.05)
+    aug = make_augment({"image_size": 28, "channels": 1}, pad=2)
+    x = jnp.ones((4, 784), jnp.float32)
+    y = jax.nn.one_hot(jnp.arange(4) % 10, 10)
+    s_plain = create_train_state(model, opt, seed=0)
+    s_aug = create_train_state(model, opt, seed=0)
+    plain = make_train_step(model, opt, keep_prob=0.75, donate=False)
+    auged = make_train_step(model, opt, keep_prob=0.75, donate=False,
+                            augment_fn=aug)
+    s_plain, _ = plain(s_plain, (x, y))
+    s_aug, _ = auged(s_aug, (x, y))
+    np.testing.assert_array_equal(np.asarray(s_plain.rng),
+                                  np.asarray(s_aug.rng))
