@@ -344,11 +344,49 @@ class PSClient:
                     time.sleep(0.2)
         return self._socks[i]
 
-    def call(self, i: int, msg: dict) -> dict:
+    # ops safe to resend after a broken connection: re-reading state, a
+    # status ping, or writes whose repeat converges to the same state
+    # (init_shard/set_step overwrite). push_grads is deliberately absent —
+    # if the request applied but the reply was lost, a resend would apply
+    # the gradient (and count the step) twice.
+    _RETRY_OPS = frozenset(
+        {"ping", "pull", "get_step", "set_step", "init_shard", "shutdown"})
+
+    def call(self, i: int, msg: dict, attempts: int = 3) -> dict:
+        """One request/response to ps task ``i``. Transient transport
+        failures (worker preemption recovery, ps restart behind the same
+        address, dropped TCP) are retried with a fresh connection for
+        idempotent ops — the reference's gRPC stack retried transparently;
+        this transport does it explicitly and only where a resend is
+        safe."""
+        if attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {attempts}")
         with self._lock:
-            sock = self._sock(i)
-            _send_msg(sock, msg)
-            return _recv_msg(sock)
+            for attempt in range(attempts):
+                # connection establishment is OUTSIDE the retry: _sock
+                # already spins its own reconnect deadline, and a connect
+                # failure means nothing was sent — resending adds no
+                # safety, only stacked timeouts (e.g. shutdown_all against
+                # an already-dead ps)
+                sock = self._sock(i)
+                try:
+                    _send_msg(sock, msg)
+                    return _recv_msg(sock)
+                except OSError:
+                    self._drop(i)
+                    if (msg.get("op") not in self._RETRY_OPS
+                            or attempt == attempts - 1):
+                        raise
+                    time.sleep(0.2 * (attempt + 1))
+
+    def _drop(self, i: int):
+        """Forget a broken connection so the next call reconnects."""
+        s, self._socks[i] = self._socks[i], None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def wait_ready(self):
         for i in range(len(self.addresses)):

@@ -283,3 +283,40 @@ def test_shutdown_op(ps_pair):
     servers, client = ps_pair
     client.call(0, {"op": "shutdown"})
     assert servers[0]._shutdown.is_set()
+
+
+def test_idempotent_call_survives_broken_connection(ps_pair):
+    """A dropped TCP connection (worker hiccup, ps restart behind the same
+    address) must not kill the worker on a read op: call() reconnects and
+    retries idempotent ops."""
+    servers, client = ps_pair
+    model = DeepCNN()
+    flat = flatten_params(model.init(jax.random.PRNGKey(0)))
+    assignment = assign_shards(list(flat), 2)
+    client.init_params(flat, assignment)
+
+    # sever the established connections out from under the client
+    for i in range(2):
+        client._socks[i].close()
+    pulled, step = client.pull_all()  # reconnects + retries
+    assert step == 0 and set(pulled) == set(flat)
+
+    client._socks[0].close()
+    assert client.call(0, {"op": "ping"})["initialized"]
+
+
+def test_push_is_not_retried_on_broken_connection(ps_pair):
+    """push_grads is not idempotent (a resend could double-apply and
+    double-count the step): a broken connection must surface, not retry."""
+    servers, client = ps_pair
+    model = DeepCNN()
+    flat = flatten_params(model.init(jax.random.PRNGKey(0)))
+    assignment = assign_shards(list(flat), 2)
+    client.init_params(flat, assignment)
+
+    client._socks[0].close()
+    grads = {k: np.zeros_like(v) for k, v in flat.items()}
+    with pytest.raises(OSError):
+        client.push_grads(grads, assignment)
+    # the dropped socket reconnects on the next (idempotent) op
+    assert client.get_step() == 0
