@@ -1,0 +1,93 @@
+"""Checkpoint inspection CLI — the ``inspect_checkpoint`` counterpart of
+TF's Saver tooling, for this build's npz pytree checkpoints.
+
+    python -m distributed_tensorflow_tpu.checkpoint.inspect --logdir /tmp/train_logs
+    python -m distributed_tensorflow_tpu.checkpoint.inspect --path ckpt-1000.npz --key params/weights/wd1
+
+Lists every stored array (path key, shape, dtype — bf16-tagged entries
+decoded), the global step, and the total parameter count; ``--key`` also
+prints one array's statistics. Read-only; works on checkpoints from every
+mode (full TrainState layouts and ps-mode params-only layouts alike).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from distributed_tensorflow_tpu.checkpoint.checkpoint import latest_checkpoint
+
+_BF16_TAG = "__bf16__"
+
+
+def load_entries(path: str) -> dict[str, np.ndarray]:
+    """{clean_key: array} with bf16-tagged entries decoded to float32 (a
+    lossless widening — npz stores them as uint16 views)."""
+    try:
+        import ml_dtypes
+
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+    except ImportError:  # pragma: no cover — ml_dtypes ships with jax
+        bf16 = None
+    out = {}
+    with np.load(path) as z:
+        for k in z.files:
+            arr = z[k]
+            if k.startswith(_BF16_TAG):
+                k = k[len(_BF16_TAG):]
+                if bf16 is not None:
+                    arr = arr.view(bf16).astype(np.float32)
+            out[k] = arr
+    return out
+
+
+def describe(path: str, key: str | None = None, out=None) -> int:
+    out = out if out is not None else sys.stdout  # bind at call time
+    entries = load_entries(path)
+    step = entries.get("step")
+    print(f"checkpoint: {path}", file=out)
+    if step is not None:
+        print(f"global step: {int(np.asarray(step))}", file=out)
+    total = 0
+    for k in sorted(entries):
+        if k == "step":
+            continue
+        a = entries[k]
+        total += a.size
+        print(f"  {k}  shape={tuple(a.shape)}  dtype={a.dtype}", file=out)
+    print(f"total elements (excl. step): {total:,}", file=out)
+    if key is not None:
+        if key not in entries:
+            print(f"error: no array {key!r} in checkpoint "
+                  f"(keys: {sorted(entries)[:8]}...)", file=sys.stderr)
+            return 2
+        a = np.asarray(entries[key], np.float64)
+        print(f"{key}: min={a.min():.6g} max={a.max():.6g} "
+              f"mean={a.mean():.6g} std={a.std():.6g}", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Inspect a distributed_tensorflow_tpu checkpoint")
+    p.add_argument("--logdir", help="checkpoint directory (inspects the "
+                   "latest checkpoint, like restore does)")
+    p.add_argument("--path", help="a specific ckpt-N.npz file")
+    p.add_argument("--key", help="also print statistics of this array")
+    args = p.parse_args(argv)
+    if bool(args.logdir) == bool(args.path):
+        p.error("exactly one of --logdir / --path is required")
+    path = args.path
+    if args.logdir:
+        found = latest_checkpoint(args.logdir)
+        if found is None:
+            print(f"no checkpoint found in {args.logdir}", file=sys.stderr)
+            return 1
+        path = found[0]
+    return describe(path, args.key)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -151,6 +151,9 @@ def define_reference_flags():
                    "dominant FC layer (deep_cnn only)")
     DEFINE_boolean("test_eval", True, "Evaluate on the test split at the end "
                    "(the reference never does; targets require it)")
+    DEFINE_boolean("eval_only", False, "Restore the latest checkpoint from "
+                   "--logdir and evaluate the full test split — no "
+                   "training. Works on checkpoints from every mode")
     DEFINE_integer("eval_step", 0, "If > 0, also evaluate on the FULL test "
                    "split every this many steps (logged as test_accuracy/"
                    "test_loss scalars). 0 = end-of-run only; the reference "

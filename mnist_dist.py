@@ -34,6 +34,13 @@ FLAGS = flags.FLAGS
 
 
 def main(_):
+    if FLAGS.eval_only:
+        # restore-and-measure, no training, any checkpoint layout — runs
+        # before role dispatch so it works regardless of cluster flags
+        from distributed_tensorflow_tpu.training.loop import evaluate_only
+
+        evaluate_only(FLAGS)
+        return 0
     if FLAGS.prng != "threefry":
         # must land before any PRNG key is created; affects dropout masks
         # and --device_data's on-device batch sampling

@@ -1,0 +1,154 @@
+"""Checkpoint inspection CLI + --eval_only restore-and-measure mode."""
+
+import io
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu import flags
+from distributed_tensorflow_tpu.checkpoint.checkpoint import save_checkpoint
+from distributed_tensorflow_tpu.checkpoint.inspect import describe, main as inspect_main
+from distributed_tensorflow_tpu.models import DeepCNN
+from distributed_tensorflow_tpu.training import adam, create_train_state
+from distributed_tensorflow_tpu.training.loop import evaluate_only, train
+
+
+@pytest.fixture(autouse=True)
+def fresh_flags():
+    flags.define_reference_flags()
+    flags.FLAGS._reset()
+    yield
+    flags.FLAGS._reset()
+
+
+def _write_ckpt(tmp_path, step=7):
+    import jax.numpy as jnp
+
+    state = create_train_state(DeepCNN(), adam(1e-3), seed=0)
+    state = state._replace(step=jnp.asarray(step, jnp.int32))
+    return save_checkpoint(str(tmp_path), state, step), state
+
+
+def test_describe_lists_arrays_and_step(tmp_path):
+    path, state = _write_ckpt(tmp_path)
+    out = io.StringIO()
+    assert describe(path, out=out) == 0
+    text = out.getvalue()
+    assert "global step: 7" in text
+    assert "params/weights/wd1  shape=(3136, 1024)  dtype=float32" in text
+    n = sum(a.size for a in jax.tree.leaves(state))
+    assert f"total elements (excl. step): {n - 1:,}" in text
+
+
+def test_describe_key_stats(tmp_path):
+    path, state = _write_ckpt(tmp_path)
+    out = io.StringIO()
+    assert describe(path, key="params/biases/out", out=out) == 0
+    assert "mean=0.1" in out.getvalue()
+
+
+def test_describe_missing_key(tmp_path):
+    path, _ = _write_ckpt(tmp_path)
+    assert describe(path, key="params/nope") == 2
+
+
+def test_inspect_main_logdir(tmp_path, capsys):
+    _write_ckpt(tmp_path, step=12)
+    assert inspect_main([f"--logdir={tmp_path}"]) == 0
+    assert "global step: 12" in capsys.readouterr().out
+
+
+def test_inspect_main_empty_logdir(tmp_path):
+    assert inspect_main([f"--logdir={tmp_path}"]) == 1
+
+
+def test_eval_only_restores_and_reports(tmp_path, capsys):
+    # train briefly so the logdir has a real checkpoint
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+        "--training_iter=25", "--batch_size=64", "--display_step=25",
+        "--optimizer=adam", "--save_model_secs=100000",
+    ])
+    res = train(flags.FLAGS, mode="local")
+    capsys.readouterr()
+
+    m = evaluate_only(flags.FLAGS)
+    out = capsys.readouterr().out
+    assert m["accuracy"] == pytest.approx(res.test_metrics["accuracy"],
+                                          abs=1e-6)
+    line = [l for l in out.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["step"] == 25 and rec["test_accuracy"] == pytest.approx(
+        m["accuracy"], abs=1e-6)
+
+
+def test_eval_only_without_checkpoint_is_loud(tmp_path):
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/empty", f"--data_dir={tmp_path}/none",
+    ])
+    with pytest.raises(FileNotFoundError, match="no checkpoint"):
+        evaluate_only(flags.FLAGS)
+
+
+def test_eval_only_ignores_training_time_flags(tmp_path, capsys):
+    """A checkpoint trained with rbg PRNG + momentum + a schedule must
+    evaluate under completely different flags: eval restores only
+    params (+model_state), never optimizer slots or the rng key."""
+    import jax.numpy as jnp
+
+    prev = jax.config.jax_default_prng_impl
+    jax.config.update("jax_default_prng_impl", "rbg")
+    try:
+        from distributed_tensorflow_tpu.training import get_optimizer, get_schedule
+
+        opt = get_optimizer("momentum", get_schedule("cosine", 0.1, 10))
+        state = create_train_state(DeepCNN(), opt, seed=0)
+        assert state.rng.shape == (4,)  # rbg key in the checkpoint
+        state = state._replace(step=jnp.asarray(9, jnp.int32))
+        save_checkpoint(f"{tmp_path}/logs", state, 9)
+    finally:
+        jax.config.update("jax_default_prng_impl", prev)
+
+    # evaluate under threefry + default sgd + no schedule
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+    ])
+    m = evaluate_only(flags.FLAGS)
+    assert 0.0 <= m["accuracy"] <= 1.0
+    assert '"step": 9' in capsys.readouterr().out
+
+
+def test_eval_only_stateful_full_layout(tmp_path):
+    """A full-TrainState checkpoint of a stateful model evaluates with its
+    stored batch-norm statistics."""
+    from distributed_tensorflow_tpu.models import get_model
+
+    model = get_model("resnet20", image_size=32, channels=3, num_classes=10)
+    state = create_train_state(model, adam(1e-3), seed=0)
+    save_checkpoint(f"{tmp_path}/logs", state, 4)
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+        "--model=resnet20", "--dataset=cifar10",
+    ])
+    m = evaluate_only(flags.FLAGS)
+    assert 0.0 <= m["accuracy"] <= 1.0
+
+
+def test_eval_only_refuses_stateful_without_model_state(tmp_path):
+    """A params-only (ps-layout) checkpoint of a stateful model must be
+    refused — evaluating with untrained batch-norm statistics would be
+    silently wrong."""
+    from distributed_tensorflow_tpu.models import get_model
+
+    model = get_model("resnet20", image_size=8, channels=3, num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0))
+    save_checkpoint(f"{tmp_path}/logs",
+                    {"params": variables["params"], "step": 3}, 3)
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+        "--model=resnet20", "--dataset=cifar10",
+    ])
+    with pytest.raises(ValueError, match="no model_state"):
+        evaluate_only(flags.FLAGS)
