@@ -1,6 +1,7 @@
 from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     Checkpointer,
     background_save_from_flags,
+    max_to_keep_from_flags,
     save_checkpoint,
     restore_latest,
     latest_checkpoint,
@@ -9,6 +10,7 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import (
 __all__ = [
     "Checkpointer",
     "background_save_from_flags",
+    "max_to_keep_from_flags",
     "save_checkpoint",
     "restore_latest",
     "latest_checkpoint",

@@ -127,6 +127,11 @@ def background_save_from_flags(FLAGS) -> bool:
     return bool(getattr(FLAGS, "async_checkpoint", False))
 
 
+def max_to_keep_from_flags(FLAGS) -> int:
+    """Same role for ``--max_to_keep`` (default mirrors Checkpointer's)."""
+    return int(getattr(FLAGS, "max_to_keep", 5))
+
+
 class Checkpointer:
     """Time-cadenced, chief-only checkpointing (Supervisor parity).
 

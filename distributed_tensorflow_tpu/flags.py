@@ -145,6 +145,8 @@ def define_reference_flags():
                  "this build applies it")
     DEFINE_string("logdir", "/tmp/train_logs", "Checkpoint/metrics directory (reference default)")
     DEFINE_integer("save_model_secs", 600, "Checkpoint cadence in seconds (reference default)")
+    DEFINE_integer("max_to_keep", 5, "Checkpoints retained before GC "
+                   "(TF Saver's default); older ones are deleted")
     DEFINE_integer("seed", 0, "PRNG seed")
     DEFINE_boolean("bf16", False, "Run matmuls/convs in bfloat16 on the MXU")
     DEFINE_boolean("pallas", False, "Use the fused Pallas kernel for the "

@@ -560,10 +560,14 @@ def run_worker(cluster, FLAGS) -> int:
     flat_template = flatten_params(template)
     assignment = assign_shards(list(flat_template), cluster.num_tasks("ps"))
 
-    from distributed_tensorflow_tpu.checkpoint import background_save_from_flags
+    from distributed_tensorflow_tpu.checkpoint import (
+        background_save_from_flags,
+        max_to_keep_from_flags,
+    )
 
     ckpt = Checkpointer(FLAGS.logdir, is_chief=is_chief,
                         save_model_secs=FLAGS.save_model_secs,
+                        max_to_keep=max_to_keep_from_flags(FLAGS),
                         background=background_save_from_flags(FLAGS))
     if is_chief:
         restored = ckpt.restore({"params": template, "step": 0})

@@ -24,7 +24,10 @@ from typing import Any
 
 import jax
 
-from distributed_tensorflow_tpu.checkpoint import background_save_from_flags
+from distributed_tensorflow_tpu.checkpoint import (
+    background_save_from_flags,
+    max_to_keep_from_flags,
+)
 from distributed_tensorflow_tpu.data import read_data_sets
 from distributed_tensorflow_tpu.data.pipeline import batch_iterator, prefetch_to_device
 from distributed_tensorflow_tpu.models import get_model
@@ -227,6 +230,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         is_chief=(FLAGS.task_index == 0),
         logdir=FLAGS.logdir,
         save_model_secs=FLAGS.save_model_secs,
+        max_to_keep=max_to_keep_from_flags(FLAGS),
         background_save=background_save_from_flags(FLAGS),
     )
     logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
@@ -499,6 +503,7 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
         is_chief=(FLAGS.task_index == 0),
         logdir=FLAGS.logdir,
         save_model_secs=FLAGS.save_model_secs,
+        max_to_keep=max_to_keep_from_flags(FLAGS),
         background_save=background_save_from_flags(FLAGS),
     )
     logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
