@@ -8,7 +8,9 @@ import jax
 import numpy as np
 import pytest
 
-import bench
+_PRNG_BEFORE_BENCH_IMPORT = jax.config.jax_default_prng_impl
+
+import bench  # noqa: E402 — the capture above must precede this import
 from distributed_tensorflow_tpu.data import read_data_sets
 
 
@@ -77,3 +79,12 @@ def test_sync_every_matches_backend():
     assert bench._sync_every(1) == 0
     expected = 16 if jax.default_backend() == "cpu" else 0
     assert bench._sync_every(8) == expected
+
+
+def test_bench_import_does_not_flip_global_prng():
+    """Regression: bench.py selects the rbg PRNG inside main() (scoped),
+    not at import time — this module imports bench, and a module-level
+    config flip leaked rbg into every test module collected afterwards
+    (changing init distributions under other tests' seeds). Assert the
+    import left the impl exactly as it found it."""
+    assert jax.config.jax_default_prng_impl == _PRNG_BEFORE_BENCH_IMPORT
