@@ -140,6 +140,10 @@ def define_reference_flags():
                   "--hidden_units; the other models don't)")
     DEFINE_string("dataset", "mnist", "Dataset: mnist|fashion_mnist|cifar10")
     DEFINE_string("optimizer", "sgd", "Optimizer: sgd|momentum|adam (reference: sgd)")
+    DEFINE_float("weight_decay", 0.0, "Decoupled weight decay: the update "
+                 "subtracts lr*wd*param alongside the gradient step "
+                 "(AdamW semantics for adam; classic L2 for plain sgd). "
+                 "local/sync/TP/device_data modes; ps mode rejects it")
     DEFINE_float("keep_prob", 0.75, "Dropout keep probability during training. "
                  "The reference defines DROPOUT=0.75 but feeds 1.0 (disabled); "
                  "this build applies it")

@@ -548,6 +548,12 @@ def run_worker(cluster, FLAGS) -> int:
             "cycle pushes one batch's gradients per pull); use sync/local "
             "mode"
         )
+    if getattr(FLAGS, "weight_decay", 0.0) > 0:
+        raise ValueError(
+            "--weight_decay is not supported in ps mode (the ps-side "
+            "optimizer applies plain sgd/momentum/adam); use sync/local "
+            "mode"
+        )
     ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
                         seed=FLAGS.seed + FLAGS.task_index)
     model = build_model_for(FLAGS, ds.meta)

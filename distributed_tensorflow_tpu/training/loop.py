@@ -101,7 +101,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
                         seed=data_seed, validation_size=FLAGS.validation_size)
     model = build_model_for(FLAGS, ds.meta)
-    opt = get_optimizer(FLAGS.optimizer, schedule_from_flags(FLAGS))
+    opt = get_optimizer(FLAGS.optimizer, schedule_from_flags(FLAGS),
+                        weight_decay=getattr(FLAGS, "weight_decay", 0.0))
     state = create_train_state(model, opt, seed=FLAGS.seed)
 
     n_chips = 1

@@ -60,28 +60,52 @@ def _lr_at(learning_rate, step):
     return learning_rate(step)
 
 
-def sgd(learning_rate) -> Optimizer:
+def _check_wd(weight_decay) -> float:
+    """Weight decay must be non-negative — a negative value would be
+    anti-regularization (weights actively grown every step), never what a
+    sign typo meant. Callers keep their original update lambdas on the
+    zero path: ``0.0*p`` is not foldable under IEEE semantics (0*inf=nan),
+    so it would both cost an elementwise pass and NaN-poison a diverged
+    leaf."""
+    wd = float(weight_decay)
+    if wd < 0:
+        raise ValueError(f"weight_decay must be >= 0, got {wd}")
+    return wd
+
+
+def sgd(learning_rate, weight_decay: float = 0.0) -> Optimizer:
     """Vanilla SGD — parity with ``GradientDescentOptimizer`` (MNISTDist.py:149).
 
     ``learning_rate`` is a float (reference behavior) or a
     ``schedules.Schedule`` callable evaluated on the global step; either
     way the opt_state is the empty tuple (the schedule reads
-    ``TrainState.step``, which checkpoints already carry)."""
+    ``TrainState.step``, which checkpoints already carry).
+    ``weight_decay`` adds decoupled decay ``-lr*wd*param`` to the update
+    (for plain SGD this coincides with classic L2 regularization)."""
+    wd = _check_wd(weight_decay)
 
     def init(params):
         return ()
 
     def update(grads, opt_state, params, step=None):
         lr = _lr_at(learning_rate, step)
-        updates = jax.tree.map(lambda g: -lr * g, grads)
+        if wd:
+            updates = jax.tree.map(lambda g, p: -lr * (g + wd * p),
+                                   grads, params)
+        else:
+            updates = jax.tree.map(lambda g: -lr * g, grads)
         return updates, opt_state
 
     return Optimizer(init, update)
 
 
-def momentum(learning_rate, beta: float = 0.9) -> Optimizer:
+def momentum(learning_rate, beta: float = 0.9,
+             weight_decay: float = 0.0) -> Optimizer:
     """SGD with momentum; opt_state is the bare velocity tree regardless
-    of whether ``learning_rate`` is a float or a schedule."""
+    of whether ``learning_rate`` is a float or a schedule. Weight decay is
+    DECOUPLED (applied to the update, not fed through the velocity) so its
+    strength doesn't compound with ``beta``."""
+    wd = _check_wd(weight_decay)
 
     def init(params):
         return jax.tree.map(jnp.zeros_like, params)
@@ -89,18 +113,25 @@ def momentum(learning_rate, beta: float = 0.9) -> Optimizer:
     def update(grads, vel, params, step=None):
         lr = _lr_at(learning_rate, step)
         vel = jax.tree.map(lambda v, g: beta * v + g, vel, grads)
-        updates = jax.tree.map(lambda v: -lr * v, vel)
+        if wd:
+            updates = jax.tree.map(lambda v, p: -lr * (v + wd * p),
+                                   vel, params)
+        else:
+            updates = jax.tree.map(lambda v: -lr * v, vel)
         return updates, vel
 
     return Optimizer(init, update)
 
 
-def adam(learning_rate, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> Optimizer:
+def adam(learning_rate, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+         weight_decay: float = 0.0) -> Optimizer:
     """Adam — not in the reference (SGD only); provided because the
     <60s-to-99% target wants a faster optimizer than SGD@0.001.
     ``learning_rate`` may be a float or a schedule callable (evaluated on
     the global step like the other optimizers; the ``t`` slot stays what
-    it always was — the bias-correction count)."""
+    it always was — the bias-correction count). Nonzero ``weight_decay``
+    makes this AdamW: decay decoupled from the moment estimates."""
+    wd = _check_wd(weight_decay)
 
     def init(params):
         zeros = lambda: jax.tree.map(jnp.zeros_like, params)
@@ -113,7 +144,14 @@ def adam(learning_rate, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -
         v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, st["v"], grads)
         tf_ = t.astype(jnp.float32)
         scale = lr * jnp.sqrt(1 - b2**tf_) / (1 - b1**tf_)
-        updates = jax.tree.map(lambda m_, v_: -scale * m_ / (jnp.sqrt(v_) + eps), m, v)
+        if wd:
+            updates = jax.tree.map(
+                lambda m_, v_, p: -(scale * m_ / (jnp.sqrt(v_) + eps)
+                                    + lr * wd * p),
+                m, v, params)
+        else:
+            updates = jax.tree.map(
+                lambda m_, v_: -scale * m_ / (jnp.sqrt(v_) + eps), m, v)
         return updates, {"m": m, "v": v, "t": t}
 
     return Optimizer(init, update)
@@ -122,11 +160,12 @@ def adam(learning_rate, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -
 _OPTIMIZERS = {"sgd": sgd, "momentum": momentum, "adam": adam}
 
 
-def get_optimizer(name: str, learning_rate) -> Optimizer:
+def get_optimizer(name: str, learning_rate, weight_decay: float = 0.0) -> Optimizer:
     try:
-        return _OPTIMIZERS[name](learning_rate)
+        factory = _OPTIMIZERS[name]
     except KeyError:
         raise ValueError(f"unknown optimizer {name!r}; available: {sorted(_OPTIMIZERS)}") from None
+    return factory(learning_rate, weight_decay=weight_decay)
 
 
 def apply_updates(params, updates):

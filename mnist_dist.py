@@ -68,6 +68,11 @@ def main(_):
                   "gradients per pull/push cycle); use sync/local mode",
                   file=sys.stderr)
             return 2
+        if FLAGS.weight_decay > 0:
+            print("--weight_decay is not supported in ps mode (plain "
+                  "ps-side optimizers); use sync/local mode",
+                  file=sys.stderr)
+            return 2
         from distributed_tensorflow_tpu.parallel import ps_emulation
 
         if FLAGS.job_name == "ps":
