@@ -300,13 +300,15 @@ def ps_emulation_phase(ds) -> float:
 def feeddict_baseline_phase(ds, n_chips) -> float:
     """Measured same-machine baseline: the reference's per-step host feed
     (f32 pixels + one-hot f32 labels uploaded synchronously each step,
-    batch 128, f32 compute) driving the same compiled step. Everything this
-    build's input path improves on is deliberately absent here."""
+    batch 128, f32 compute, plain SGD at the reference's default lr —
+    GradientDescentOptimizer(0.001), MNISTDist.py:30,149) driving the same
+    compiled step. Everything this build's input path improves on is
+    deliberately absent here."""
     from distributed_tensorflow_tpu.models import DeepCNN
-    from distributed_tensorflow_tpu.training import adam
+    from distributed_tensorflow_tpu.training import sgd
 
     model = DeepCNN()  # f32 compute
-    state, step_fn, stage = _build(model, adam(1e-3), n_chips)
+    state, step_fn, stage = _build(model, sgd(1e-3), n_chips)
 
     batch_size = -(-FEEDDICT_BATCH // n_chips) * n_chips
     state, _ = step_fn(state, _stage_feed(ds, batch_size, stage))  # compile

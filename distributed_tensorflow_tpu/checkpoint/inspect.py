@@ -22,9 +22,12 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import latest_checkpoint
 _BF16_TAG = "__bf16__"
 
 
-def load_entries(path: str) -> dict[str, np.ndarray]:
-    """{clean_key: array} with bf16-tagged entries decoded to float32 (a
-    lossless widening — npz stores them as uint16 views)."""
+def load_entries(path: str) -> tuple[dict[str, np.ndarray], set[str]]:
+    """({clean_key: array}, undecoded_keys) with bf16-tagged entries decoded
+    to float32 (a lossless widening — npz stores them as uint16 views).
+    ``undecoded_keys`` names bf16-tagged entries left as raw uint16 views
+    because ml_dtypes was unavailable — their values are NOT interpretable
+    as numbers."""
     try:
         import ml_dtypes
 
@@ -32,6 +35,7 @@ def load_entries(path: str) -> dict[str, np.ndarray]:
     except ImportError:  # pragma: no cover — ml_dtypes ships with jax
         bf16 = None
     out = {}
+    undecoded = set()
     with np.load(path) as z:
         for k in z.files:
             arr = z[k]
@@ -39,13 +43,15 @@ def load_entries(path: str) -> dict[str, np.ndarray]:
                 k = k[len(_BF16_TAG):]
                 if bf16 is not None:
                     arr = arr.view(bf16).astype(np.float32)
+                else:
+                    undecoded.add(k)
             out[k] = arr
-    return out
+    return out, undecoded
 
 
 def describe(path: str, key: str | None = None, out=None) -> int:
     out = out if out is not None else sys.stdout  # bind at call time
-    entries = load_entries(path)
+    entries, undecoded = load_entries(path)
     step = entries.get("step")
     print(f"checkpoint: {path}", file=out)
     if step is not None:
@@ -56,12 +62,20 @@ def describe(path: str, key: str | None = None, out=None) -> int:
             continue
         a = entries[k]
         total += a.size
-        print(f"  {k}  shape={tuple(a.shape)}  dtype={a.dtype}", file=out)
+        dtype = "bfloat16 (raw bits; no ml_dtypes)" if k in undecoded else a.dtype
+        print(f"  {k}  shape={tuple(a.shape)}  dtype={dtype}", file=out)
     print(f"total elements (excl. step): {total:,}", file=out)
     if key is not None:
         if key not in entries:
             print(f"error: no array {key!r} in checkpoint "
                   f"(keys: {sorted(entries)[:8]}...)", file=sys.stderr)
+            return 2
+        if key in undecoded:
+            # the stored array is a raw uint16 view of bf16 bits; stats on
+            # it would be meaningless — refuse rather than mislead
+            print(f"error: {key!r} is stored as bf16 and ml_dtypes is not "
+                  f"available to decode it; install ml_dtypes to print "
+                  f"statistics", file=sys.stderr)
             return 2
         a = np.asarray(entries[key], np.float64)
         print(f"{key}: min={a.min():.6g} max={a.max():.6g} "

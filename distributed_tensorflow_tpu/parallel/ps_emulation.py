@@ -523,6 +523,41 @@ def make_grad_fn(model, keep_prob: float, devices=None):
     )
 
 
+def ps_unsupported_flag_error(FLAGS) -> str | None:
+    """First unsupported-flag error for ps mode, or None.
+
+    The single source of truth for which training features the ps topology
+    refuses — used both by ``run_worker`` (raise) and the ``mnist_dist``
+    dispatch (print + exit 2, failing EVERY role fast so ps processes
+    don't block in serve_forever() while the workers die at startup).
+    Loud, not silent: the ps applies a fixed rate pushed at init
+    (reference parity — ApplyGradientDescent with a constant lr,
+    MNISTDist.py:149); these features would otherwise silently not happen.
+    """
+    if (getattr(FLAGS, "lr_schedule", "constant") != "constant"
+            or getattr(FLAGS, "warmup_steps", 0) > 0):
+        return ("--lr_schedule/--warmup_steps are not supported in ps mode; "
+                "the parameter server applies a fixed learning rate. Use "
+                "sync/local mode for scheduled learning rates.")
+    if getattr(FLAGS, "accum_steps", 1) > 1:
+        return ("--accum_steps is not supported in ps mode (the reference's "
+                "cycle pushes one batch's gradients per pull); use "
+                "sync/local mode")
+    if getattr(FLAGS, "weight_decay", 0.0) > 0:
+        return ("--weight_decay is not supported in ps mode (the ps-side "
+                "optimizer applies plain sgd/momentum/adam); use sync/local "
+                "mode")
+    if getattr(FLAGS, "augment", False):
+        return ("--augment is not supported in ps mode (augmentation is "
+                "compiled into the sync/local train step); use sync/local "
+                "mode")
+    if getattr(FLAGS, "eval_step", 0) > 0:
+        return ("--eval_step is not supported in ps mode (workers display "
+                "on the pulled snapshot via --display_step; full test evals "
+                "run at exit with --test_eval); use sync/local mode")
+    return None
+
+
 def run_worker(cluster, FLAGS) -> int:
     """The worker role: async stale-gradient SGD against the ps tasks —
     the reference's hot loop (MNISTDist.py:172-188) with XLA compute."""
@@ -532,28 +567,9 @@ def run_worker(cluster, FLAGS) -> int:
     from distributed_tensorflow_tpu.training.train_state import evaluate
     from distributed_tensorflow_tpu.utils import MetricsLogger
 
-    if (getattr(FLAGS, "lr_schedule", "constant") != "constant"
-            or getattr(FLAGS, "warmup_steps", 0) > 0):
-        # loud, not silent: the ps applies a fixed rate pushed at init
-        # (reference parity — ApplyGradientDescent with a constant lr,
-        # MNISTDist.py:149); a schedule would silently not happen here
-        raise ValueError(
-            "--lr_schedule/--warmup_steps are not supported in ps mode; "
-            "the parameter server applies a fixed learning rate. Use "
-            "sync/local mode for scheduled learning rates."
-        )
-    if getattr(FLAGS, "accum_steps", 1) > 1:
-        raise ValueError(
-            "--accum_steps is not supported in ps mode (the reference's "
-            "cycle pushes one batch's gradients per pull); use sync/local "
-            "mode"
-        )
-    if getattr(FLAGS, "weight_decay", 0.0) > 0:
-        raise ValueError(
-            "--weight_decay is not supported in ps mode (the ps-side "
-            "optimizer applies plain sgd/momentum/adam); use sync/local "
-            "mode"
-        )
+    err = ps_unsupported_flag_error(FLAGS)
+    if err is not None:
+        raise ValueError(err)
     ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
                         seed=FLAGS.seed + FLAGS.task_index)
     model = build_model_for(FLAGS, ds.meta)

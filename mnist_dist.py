@@ -55,25 +55,15 @@ def main(_):
             print(f"--job_name must be 'ps' or 'worker' when --ps_hosts is "
                   f"set (got {FLAGS.job_name!r})", file=sys.stderr)
             return 2
-        if FLAGS.lr_schedule != "constant" or FLAGS.warmup_steps > 0:
-            # fail EVERY role fast at dispatch — the run_worker guard alone
-            # would leave ps processes blocked in serve_forever() while the
-            # workers die at startup
-            print("--lr_schedule/--warmup_steps are not supported in ps "
-                  "mode (the ps applies a fixed learning rate); use "
-                  "sync/local mode", file=sys.stderr)
-            return 2
-        if FLAGS.accum_steps > 1:
-            print("--accum_steps is not supported in ps mode (one batch's "
-                  "gradients per pull/push cycle); use sync/local mode",
-                  file=sys.stderr)
-            return 2
-        if FLAGS.weight_decay > 0:
-            print("--weight_decay is not supported in ps mode (plain "
-                  "ps-side optimizers); use sync/local mode",
-                  file=sys.stderr)
-            return 2
         from distributed_tensorflow_tpu.parallel import ps_emulation
+
+        # fail EVERY role fast at dispatch — the run_worker guard alone
+        # would leave ps processes blocked in serve_forever() while the
+        # workers die at startup
+        err = ps_emulation.ps_unsupported_flag_error(FLAGS)
+        if err is not None:
+            print(err, file=sys.stderr)
+            return 2
 
         if FLAGS.job_name == "ps":
             # reference: server.join() — serve parameters until killed

@@ -152,3 +152,28 @@ def test_eval_only_refuses_stateful_without_model_state(tmp_path):
     ])
     with pytest.raises(ValueError, match="no model_state"):
         evaluate_only(flags.FLAGS)
+
+
+def test_describe_bf16_without_ml_dtypes(tmp_path, monkeypatch):
+    """bf16-tagged entries with ml_dtypes unavailable: the listing labels
+    the raw storage, and --key stats are refused instead of printing
+    statistics of the uint16 bit view (round-2 advisor finding)."""
+    import sys
+
+    import jax.numpy as jnp
+
+    path = save_checkpoint(
+        str(tmp_path), {"params": {"w": jnp.full((4,), 1.5, jnp.bfloat16)},
+                        "step": 1}, 1)
+    monkeypatch.setitem(sys.modules, "ml_dtypes", None)  # import -> ImportError
+
+    out = io.StringIO()
+    assert describe(path, out=out) == 0
+    assert "raw bits" in out.getvalue()
+    assert describe(path, key="params/w", out=io.StringIO()) == 2
+
+    # with ml_dtypes present (the normal case) the same key decodes
+    monkeypatch.delitem(sys.modules, "ml_dtypes")
+    out = io.StringIO()
+    assert describe(path, key="params/w", out=out) == 0
+    assert "mean=1.5" in out.getvalue()

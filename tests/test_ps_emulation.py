@@ -320,3 +320,26 @@ def test_push_is_not_retried_on_broken_connection(ps_pair):
         client.push_grads(grads, assignment)
     # the dropped socket reconnects on the next (idempotent) op
     assert client.get_step() == 0
+
+
+def test_ps_mode_rejects_augment_and_eval_step():
+    """--augment / --eval_step are compiled into (or drive) the sync/local
+    loops only; a ps-mode run must refuse them loudly, not silently train
+    unaugmented / skip the evals (round-2 advisor finding)."""
+    from distributed_tensorflow_tpu.parallel.ps_emulation import run_worker
+
+    class F:
+        lr_schedule = "constant"
+        warmup_steps = 0
+        accum_steps = 1
+        weight_decay = 0.0
+        augment = True
+        eval_step = 0
+
+    with pytest.raises(ValueError, match="--augment is not supported in ps"):
+        run_worker(None, F)
+
+    F.augment = False
+    F.eval_step = 10
+    with pytest.raises(ValueError, match="--eval_step is not supported in ps"):
+        run_worker(None, F)
