@@ -171,15 +171,21 @@ class Checkpointer:
         self._thread: threading.Thread | None = None
         self._closed = False
 
+    def cadence_due(self) -> bool:
+        """True when the chief's time-based save cadence has elapsed —
+        exposed so multi-host loops can broadcast the decision (the vote in
+        training/loop._HostCoordinator) before entering the collective
+        state fetch together."""
+        return (self.is_chief and self.save_model_secs > 0
+                and time.time() - self._last_save >= self.save_model_secs)
+
     def maybe_save(self, state, step: int) -> str | None:
         """Returns the path of a checkpoint written synchronously, else
         None. In background mode the cadenced write completes
         asynchronously (and may be superseded by a newer one before it
         starts — latest wins), so no path is promised; ``wait()`` then
         ``latest_checkpoint`` observe the result."""
-        if not self.is_chief or self.save_model_secs <= 0:
-            return None
-        if time.time() - self._last_save < self.save_model_secs:
+        if not self.cadence_due():
             return None
         if self.background:
             self._submit(state, step)
@@ -193,6 +199,14 @@ class Checkpointer:
         after this one."""
         if not self.is_chief:
             return None
+        return self.save_fetched(flatten_pytree(state, tag_bf16=True), step)
+
+    def save_fetched(self, flat: dict[str, np.ndarray], step: int) -> str | None:
+        """Synchronous write of an ALREADY-FETCHED flat snapshot (the
+        coordinated multi-host path: every process fetches collectively,
+        only the chief lands here with the result)."""
+        if not self.is_chief:
+            return None
         self._drain()
         if self._error is not None:
             # an older periodic write failed; this newer forced save
@@ -200,9 +214,21 @@ class Checkpointer:
             print(f"note: a background checkpoint write had failed: "
                   f"{self._error}")
             self._error = None
-        path = save_checkpoint(self.directory, state, step, self.max_to_keep)
+        path = _write_flat(self.directory, flat, step, self.max_to_keep)
         self._last_save = time.time()
         return path
+
+    def submit_fetched(self, flat: dict[str, np.ndarray], step: int) -> None:
+        """Background-or-sync write of an already-fetched snapshot, per the
+        ``background`` setting — the cadenced half of the coordinated
+        multi-host path."""
+        if not self.is_chief:
+            return
+        if self.background:
+            self._submit_flat(flat, step)
+            self._last_save = time.time()
+        else:
+            self.save_fetched(flat, step)
 
     def wait(self):
         """Block until no background write is pending or running; raise if
@@ -232,8 +258,12 @@ class Checkpointer:
     # --- background machinery ---
 
     def _submit(self, state, step: int):
+        self._submit_flat(flatten_pytree(state, tag_bf16=True), step)
+
+    def _submit_flat(self, flat: dict[str, np.ndarray], step: int):
+        # the device→host fetch happened on the calling thread (ordered
+        # with the dispatch queue); only the file write backgrounds
         self._raise_pending_error()
-        flat = flatten_pytree(state, tag_bf16=True)  # device→host, ordered
         with self._cv:
             if self._closed:
                 raise RuntimeError("Checkpointer is closed")

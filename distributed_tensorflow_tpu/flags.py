@@ -115,6 +115,16 @@ def run(main: Callable | None = None, argv=None):
     sys.exit(main([sys.argv[0]] + extra))
 
 
+COORD_STEPS_DEFAULT = 50
+
+
+def coord_steps_from_flags(FLAGS) -> int:
+    """The one flag→feature mapping for ``--coord_steps`` (multi-host
+    vote cadence), shared by every loop that builds a _HostCoordinator so
+    the flag default and the flag-less library default cannot diverge."""
+    return int(getattr(FLAGS, "coord_steps", COORD_STEPS_DEFAULT))
+
+
 def define_reference_flags():
     """The reference's exact 10-flag surface (MNISTDist.py:13-31) plus this
     build's extensions. Idempotent."""
@@ -170,7 +180,10 @@ def define_reference_flags():
                   "--profile_steps post-compile training steps into this dir")
     DEFINE_integer("profile_steps", 10, "Number of steps in the profiler window")
     DEFINE_integer("validation_size", 0, "Examples held out of the train split "
-                   "as a validation DataSet (0 = none, reference behavior)")
+                   "as a validation DataSet (0 = none, reference behavior). "
+                   "With --eval_step the periodic evals run on this split "
+                   "(validation_accuracy/validation_loss scalars) and the "
+                   "test split is touched only by the final --test_eval")
     DEFINE_boolean("raw_input", False, "Feed uint8 images + int32 labels and "
                    "normalize on device (4x less host->device traffic; "
                    "fastest path on bandwidth-limited links)")
@@ -220,6 +233,38 @@ def define_reference_flags():
                   "on-device batch sampling draw from it). Checkpoints "
                   "store the rng key, whose shape differs between "
                   "implementations: resume with the same --prng")
+    DEFINE_string("ps_wire", "f32", "PS-mode transport precision: f32 "
+                  "(exact, reference parity) or bf16 — every pulled "
+                  "param and pushed grad moves at half width over BOTH "
+                  "the TCP wire and the host<->chip link (ps-side master "
+                  "params stay f32; same precision class as bf16 compute)")
+    DEFINE_boolean("ps_prefetch", True, "PS mode, full-pull cycle only "
+                   "(sgd runs the --ps_mirror cycle by default; set "
+                   "--ps_mirror=false for this flag to apply): keep one "
+                   "parameter pull in flight, overlapping the next pull "
+                   "with the chip's gradient computation and the push "
+                   "(the pulled snapshot is one own-push staler — "
+                   "async-SGD staleness class). false = serial "
+                   "pull/compute/push reference cycle")
+    DEFINE_boolean("ps_mirror", True, "PS mode + sgd only: keep a device-"
+                   "resident mirror of the params and apply each pushed "
+                   "gradient's identical sgd update ON CHIP instead of re-"
+                   "pulling + re-uploading the full parameter set every "
+                   "cycle (the dominant transfer). The mirror resyncs from "
+                   "the ps every --ps_resync_steps and immediately when "
+                   "another worker's push is detected (the returned global "
+                   "step skips ahead). Ignored (full-pull cycle) for "
+                   "momentum/adam; =false restores the pull cycle "
+                   "--ps_prefetch controls")
+    DEFINE_integer("ps_resync_steps", 50, "Steps between full parameter "
+                   "resyncs in --ps_mirror mode (bounds any numeric drift "
+                   "between the ps-side and device-side sgd applies)")
+    DEFINE_integer("coord_steps", COORD_STEPS_DEFAULT,
+                   "Multi-host coordination cadence in "
+                   "steps: sync-mode processes agree on stop/checkpoint "
+                   "decisions with one tiny allgather every this many "
+                   "steps (worst-case stop latency = this many extra "
+                   "steps). Single-process runs never vote")
     DEFINE_boolean("async_checkpoint", True, "Write cadenced checkpoints "
                    "from a background thread (the state is fetched to "
                    "host on the training thread, then serialized and "

@@ -28,6 +28,7 @@ from distributed_tensorflow_tpu.checkpoint import (
     background_save_from_flags,
     max_to_keep_from_flags,
 )
+from distributed_tensorflow_tpu.flags import coord_steps_from_flags
 from distributed_tensorflow_tpu.data import read_data_sets
 from distributed_tensorflow_tpu.data.pipeline import batch_iterator, prefetch_to_device
 from distributed_tensorflow_tpu.models import get_model
@@ -241,8 +242,9 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
 
-    should_stop = _voting_should_stop(sv) if (mode == "sync" and n_procs > 1) \
-        else sv.should_stop
+    coord = (_HostCoordinator(sv, coord_steps_from_flags(FLAGS))
+             if (mode == "sync" and n_procs > 1) else None)
+    should_stop = coord.should_stop if coord is not None else sv.should_stop
 
     with sv.managed(state) as box:
         state, step = box.state, box.step
@@ -295,15 +297,18 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                     profile_done = True
                 periodic_eval(state, step)
                 box.update(state, step)
-                sv.maybe_checkpoint(state, step)
+                if coord is not None:
+                    coord.tick(state, step)
+                else:
+                    sv.maybe_checkpoint(state, step)
             jax.block_until_ready(state.params)
         finally:
             if profiling:
                 jax.profiler.stop_trace()
             batches.close()
 
-    test_metrics = _final_test_eval(FLAGS, periodic_eval, model, state, ds,
-                                    logger, step)
+    test_metrics = _final_test_eval(FLAGS, sv, periodic_eval, model, state,
+                                    ds, logger, step)
     print("Optimization Finished!")
     logger.close()
     return TrainResult(
@@ -377,31 +382,67 @@ def evaluate_only(FLAGS) -> dict[str, float]:
 
 
 def _periodic_test_eval(FLAGS, sv, model, ds, logger):
-    """(state, step) -> None: full test-split evaluation every
+    """(state, step) -> None: full held-out evaluation every
     ``--eval_step`` steps (crossing semantics, so chunked loops that jump
     several steps per dispatch still evaluate once per boundary). Chief
     only — it is host-side work off the compiled path; the reference never
     evaluates on the test split at all (SURVEY.md §5 metrics), the north
-    star requires it."""
+    star requires it.
+
+    With ``--validation_size`` the periodic evals run on the carved-out
+    validation split (the classic protocol: tune against validation, touch
+    the test split only at the end — the final ``--test_eval`` stays on
+    test); without one they run on the test split directly."""
+    from distributed_tensorflow_tpu.utils.pytree import (
+        fetch_pytree,
+        join_collective_fetch,
+        needs_collective_fetch,
+    )
+
     every = getattr(FLAGS, "eval_step", 0)
-    if not every or every <= 0 or not sv.is_chief:
+    if not every or every <= 0:
         noop = lambda state, step: None
         noop.prime = lambda step: None
         noop.last_result = lambda: None
         return noop
+    val = getattr(ds, "validation", None)
+    use_validation = val is not None and val.num_examples > 0
+    split, name = (val, "validation") if use_validation else (ds.test, "test")
     state_box = {"done": 0, "last": None}
 
     def maybe_eval(state, step: int):
         if step // every <= state_box["done"]:
             return
         state_box["done"] = step // every
-        m = evaluate(model, jax.device_get(state.params), ds.test,
-                     model_state=jax.device_get(state.model_state))
-        state_box["last"] = (step, m)
-        print(f"step: {step} test accuracy: {m['accuracy']} "
-              f"test loss: {m['loss']}")
-        logger.scalars(step, {"test_accuracy": m["accuracy"],
-                              "test_loss": m["loss"]})
+        # cross-host-sharded state: every process must join the collective
+        # fetch (the boundary decision is step-based, so all hosts agree
+        # without communicating); only the chief evaluates and prints. A
+        # non-chief with locally-fetchable state contributes nothing.
+        if not sv.is_chief:
+            if needs_collective_fetch(state):
+                # join the chief's cross-host gathers (params then
+                # model_state, matching its fetch order) without paying a
+                # full-model device->host copy nobody reads
+                join_collective_fetch(state.params)
+                join_collective_fetch(state.model_state)
+                if not use_validation:
+                    # record participation so the final eval's reuse
+                    # decision stays symmetric with the chief's (no
+                    # one-sided collective)
+                    state_box["last"] = (step, None)
+            return
+        params = fetch_pytree(state.params)
+        model_state = fetch_pytree(state.model_state)
+        m = evaluate(model, params, split, model_state=model_state)
+        if not use_validation:
+            # end-of-run reuse is only sound when this WAS the test split;
+            # chief and non-chief must gate identically or the final
+            # eval's fetch decision goes one-sided (see _final_test_eval)
+            state_box["last"] = (step, m)
+        print(f"step: {step} {name} accuracy: {m['accuracy']} "
+              f"{name} loss: {m['loss']}")
+        logger.scalars(step, {f"{name}_accuracy": m["accuracy"],
+                              f"{name}_loss": m["loss"]})
 
     def prime(step: int):
         # a resumed run starts counting boundaries from the restored step
@@ -414,17 +455,44 @@ def _periodic_test_eval(FLAGS, sv, model, ds, logger):
     return maybe_eval
 
 
-def _final_test_eval(FLAGS, periodic_eval, model, state, ds, logger, step):
+def _final_test_eval(FLAGS, sv, periodic_eval, model, state, ds, logger, step):
     """End-of-run test evaluation (both loops): reuses the periodic eval's
-    result when it already covered the final step."""
+    result when it already covered the final step. In multi-process runs
+    the non-chief hosts only contribute the collective state fetch (when
+    the sharding spans hosts) — the 10k-example inference and the print
+    happen once, on the chief."""
+    from distributed_tensorflow_tpu.utils.pytree import (
+        fetch_pytree,
+        join_collective_fetch,
+        needs_collective_fetch,
+    )
+
     if not FLAGS.test_eval:
         return None
+    multiproc = jax.process_count() > 1
     last = periodic_eval.last_result()
     if last is not None and last[0] == step:
         test_metrics = last[1]  # scalars already logged at this step
+        if test_metrics is None:
+            # non-chief that joined the boundary-aligned collective fetch;
+            # the chief printed/logged — nothing further to do here, and
+            # skipping the fetch below mirrors the chief's reuse branch
+            # (both sides must agree on whether a collective happens)
+            return None
     else:
-        test_metrics = evaluate(model, jax.device_get(state.params), ds.test,
-                                model_state=jax.device_get(state.model_state))
+        if multiproc and not sv.is_chief:
+            # only the collective case needs this process's participation;
+            # locally-fetchable state would be a pointless full-model
+            # device fetch discarded right after (same gate as the
+            # periodic path)
+            if needs_collective_fetch(state):
+                join_collective_fetch(state.params)
+                join_collective_fetch(state.model_state)
+            return None
+        params = fetch_pytree(state.params)
+        model_state = fetch_pytree(state.model_state)
+        test_metrics = evaluate(model, params, ds.test,
+                                model_state=model_state)
         logger.scalars(step, {"test_accuracy": test_metrics["accuracy"],
                               "test_loss": test_metrics["loss"]})
     print("test accuracy: ", test_metrics["accuracy"],
@@ -432,21 +500,55 @@ def _final_test_eval(FLAGS, periodic_eval, model, state, ds, logger, step):
     return test_metrics
 
 
-def _voting_should_stop(sv):
-    """Cross-process stop agreement: a stop (SIGTERM on one host, say) must
-    take effect at the SAME step on every process — a process leaving the
-    loop alone would deadlock the rest inside the next collective. One tiny
-    allgather per loop iteration buys that agreement. Shared by the
-    host-fed and device-resident loops; the protocol must stay identical
-    or hosts disagree on when to exit."""
-    import numpy as np
-    from jax.experimental import multihost_utils
+class _HostCoordinator:
+    """Cadenced cross-process agreement for the multi-host sync loops.
 
-    def should_stop():
-        votes = multihost_utils.process_allgather(np.int32(sv.should_stop()))
-        return bool(votes.max())
+    Two decisions need host-level agreement: a stop (SIGTERM on one host,
+    say) must take effect at the SAME step on every process — a process
+    leaving the loop alone would deadlock the rest inside the next
+    collective — and a checkpoint of cross-host-sharded state is itself a
+    collective fetch every process must enter together
+    (Supervisor.checkpoint_coordinated). Both ride ONE tiny allgather
+    every ``--coord_steps`` steps rather than a DCN round-trip per loop
+    iteration (the round-2 verdict's hot-path cost): between boundaries
+    ``should_stop`` reads a cached flag and no host traffic happens.
+    Crossing semantics (step // every) so chunked loops that jump several
+    steps per dispatch still vote once per boundary; both loops MUST keep
+    calling ``tick`` with the same step sequence or hosts deadlock in the
+    vote. Worst-case stop latency is ``coord_steps`` extra steps —
+    milliseconds of compute — and the final checkpoint still lands at the
+    agreed exit step."""
 
-    return should_stop
+    def __init__(self, sv, every: int):
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        self._sv = sv
+        self._every = max(1, every)
+        self._stop = False
+        self._boundary = None
+        self._np = np
+        self._allgather = multihost_utils.process_allgather
+
+    def should_stop(self) -> bool:
+        return self._stop
+
+    def tick(self, state, step: int) -> None:
+        """Call once per loop iteration, after ``step`` advanced. At each
+        boundary: one allgather of [stop?, chief-save-due?]; any stop vote
+        stops everyone, a save vote routes every process into the
+        coordinated checkpoint."""
+        boundary = step // self._every
+        if boundary == self._boundary:
+            return
+        self._boundary = boundary
+        votes = self._allgather(self._np.asarray(
+            [self._sv.should_stop(), self._sv.checkpointer.cadence_due()],
+            self._np.int32))
+        votes = votes.reshape(-1, 2)
+        if votes[:, 1].max():
+            self._sv.checkpoint_coordinated(state, step)
+        self._stop = bool(votes[:, 0].max())
 
 
 def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
@@ -516,8 +618,9 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
     sync_every = collective_sync_cadence(mesh is not None)
     chunks_done = 0
 
-    should_stop = _voting_should_stop(sv) if jax.process_count() > 1 \
-        else sv.should_stop
+    coord = (_HostCoordinator(sv, coord_steps_from_flags(FLAGS))
+             if jax.process_count() > 1 else None)
+    should_stop = coord.should_stop if coord is not None else sv.should_stop
 
     with sv.managed(state) as box:
         state, step = box.state, box.step
@@ -568,13 +671,16 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                 profile_done = True
             periodic_eval(state, step)
             box.update(state, step)
-            sv.maybe_checkpoint(state, step)
+            if coord is not None:
+                coord.tick(state, step)
+            else:
+                sv.maybe_checkpoint(state, step)
         jax.block_until_ready(state.params)
         if profiling:
             jax.profiler.stop_trace()
 
-    test_metrics = _final_test_eval(FLAGS, periodic_eval, model, state, ds,
-                                    logger, step)
+    test_metrics = _final_test_eval(FLAGS, sv, periodic_eval, model, state,
+                                    ds, logger, step)
     print("Optimization Finished!")
     logger.close()
     return TrainResult(

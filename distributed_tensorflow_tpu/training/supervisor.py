@@ -98,6 +98,43 @@ class Supervisor:
     def maybe_checkpoint(self, state, step: int):
         return self.checkpointer.maybe_save(state, step)
 
+    def checkpoint_coordinated(self, state, step: int):
+        """One coordinated checkpoint: EVERY process calls this together
+        (the loop's cadenced vote agreed on the boundary step first).
+
+        The fetch is the collective half — a state with leaves sharded
+        across hosts (a model axis spanning processes) is gathered with
+        ``process_allgather``, which only works if all processes
+        participate; ``jax.device_get`` alone raises on such leaves (the
+        round-2 latent crash). Only the chief writes the result. Processes
+        whose state is locally fetchable and that aren't the chief skip
+        the fetch entirely — single-host behavior is unchanged."""
+        self._coordinated_save(state, step, final=False)
+
+    def _coordinated_save(self, state, step: int, *, final: bool):
+        """The ONE implementation of the symmetric fetch-then-chief-writes
+        gate, shared by the cadenced vote path and the managed() exit so
+        the two cannot drift apart (a gate that differs between them is a
+        multi-host shutdown deadlock no single-host test catches).
+        ``final`` picks the synchronous write over the background-capable
+        one. Non-chief processes only join the cross-host collective —
+        they never pay the full-model device->host copy the chief needs
+        for the file."""
+        from distributed_tensorflow_tpu.utils.pytree import (
+            flatten_pytree,
+            join_collective_fetch,
+            needs_collective_fetch,
+        )
+
+        if self.is_chief:
+            flat = flatten_pytree(state, tag_bf16=True)
+            if final:
+                self.checkpointer.save_fetched(flat, step)
+            else:
+                self.checkpointer.submit_fetched(flat, step)
+        elif needs_collective_fetch(state):
+            join_collective_fetch(state)
+
     def _latest_is_params_only(self) -> bool:
         """True when the newest checkpoint holds exactly the ps-mode
         {"params", "step"} layout (utils/pytree path keys)."""
@@ -157,15 +194,37 @@ class Supervisor:
         restore_signals = (
             self._install_signal_handlers() if handle_signals else lambda: None
         )
+        clean_exit = False
         try:
             yield state_box
+            clean_exit = True
         finally:
             restore_signals()
-            if state_box.state is not None and self.is_chief:
-                try:
-                    self.checkpointer.save(state_box.state, state_box.step)
-                except Exception as e:  # noqa: BLE001 — shutdown best-effort
-                    print(f"final checkpoint failed: {e}")
+            if state_box.state is not None:
+                from distributed_tensorflow_tpu.utils.pytree import (
+                    needs_collective_fetch,
+                )
+
+                # cross-host-sharded state: EVERY process participates in
+                # the collective fetch (they all exit the loop at the same
+                # agreed step — the stop-vote invariant); only the chief
+                # writes. Locally-fetchable state keeps the chief-only
+                # path. On an EXCEPTION exit the collective is skipped:
+                # peers are not at a matching save (they're still training
+                # or dying themselves), so a one-sided process_allgather
+                # would hang this process forever instead of letting the
+                # job die loudly.
+                needs = needs_collective_fetch(state_box.state)
+                if needs and not clean_exit:
+                    print("final checkpoint skipped: exiting on an error "
+                          "with cross-host-sharded state (the collective "
+                          "fetch needs every process at the same point)")
+                elif self.is_chief or needs:
+                    try:
+                        self._coordinated_save(state_box.state,
+                                               state_box.step, final=True)
+                    except Exception as e:  # noqa: BLE001 — best-effort
+                        print(f"final checkpoint failed: {e}")
             self.checkpointer.close()
             self.stop()
 

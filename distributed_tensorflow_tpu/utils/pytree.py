@@ -14,6 +14,91 @@ import numpy as np
 _BF16_TAG = "__bf16__"
 
 
+def locally_fetchable(leaf) -> bool:
+    """True when this process can materialize ``leaf``'s full value without
+    talking to other processes: host arrays, fully-addressable device
+    arrays, fully-replicated global arrays, and global arrays whose
+    addressable shards cover every index (e.g. a model-axis split that
+    stays within this host, replicated over a cross-host data axis)."""
+    if not isinstance(leaf, jax.Array):
+        return True
+    if leaf.is_fully_addressable or leaf.is_fully_replicated:
+        return True
+    try:
+        imap = leaf.sharding.devices_indices_map(leaf.shape)
+    except Exception:  # noqa: BLE001 — unknown sharding: assume remote
+        return False
+    pid = jax.process_index()
+    local = {str(idx) for d, idx in imap.items() if d.process_index == pid}
+    return local == {str(idx) for idx in imap.values()}
+
+
+def needs_collective_fetch(tree) -> bool:
+    """True when fetching ``tree`` to host requires other processes'
+    cooperation (some leaf's data lives only on non-addressable devices).
+    With GSPMD meshes the answer is identical on every process — the mesh
+    is a regular grid over processes — which is what lets callers agree on
+    whether to enter the collective path without communicating first."""
+    return any(not locally_fetchable(l) for l in jax.tree_util.tree_leaves(tree))
+
+
+def _fetch_leaves(leaves: list) -> list[np.ndarray]:
+    """Leaves -> host ndarrays, transfers batched: locally-fetchable
+    leaves go through ONE ``jax.device_get`` call (~2x faster than
+    per-leaf gets for the same bytes on tunneled chips — PERF.md), and
+    cross-host-sharded leaves ride ONE ``process_allgather`` of the whole
+    spanning subset (one DCN collective instead of one per leaf). The
+    allgather is COLLECTIVE: every process must reach it with the same
+    spanning leaves — guaranteed when all processes hold the same
+    sharding layout (GSPMD meshes), which makes the local/spanning split
+    identical everywhere."""
+    out: list = [None] * len(leaves)
+    local_idx, local_vals = [], []
+    span_idx, span_vals = [], []
+    for j, leaf in enumerate(leaves):
+        if locally_fetchable(leaf):
+            local_idx.append(j)
+            local_vals.append(leaf)
+        else:
+            span_idx.append(j)
+            span_vals.append(leaf)
+    if span_vals:
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(span_vals, tiled=True)
+        for j, v in zip(span_idx, gathered):
+            out[j] = np.asarray(v)
+    for j, v in zip(local_idx, jax.device_get(local_vals)):
+        out[j] = np.asarray(v)
+    return out
+
+
+def join_collective_fetch(tree) -> None:
+    """Participate in ``fetch_pytree``'s collective WITHOUT materializing
+    the local leaves: gathers only the cross-host-sharded subset and
+    discards it. Non-chief processes use this to pair up with the chief's
+    full fetch during coordinated checkpoints/evals — paying the DCN
+    collective they must join, but not a full-model device->host copy
+    whose result nobody reads."""
+    span = [l for l in jax.tree_util.tree_leaves(tree)
+            if not locally_fetchable(l)]
+    if span:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.process_allgather(span, tiled=True)
+
+
+def fetch_pytree(tree):
+    """Pytree of arrays -> same-structure pytree of host ndarrays, the
+    device->host transfers batched into one call.
+
+    Collective whenever ``needs_collective_fetch(tree)`` — then EVERY
+    process must call it with the same tree (checkpoint/eval paths vote on
+    a step boundary first, training/loop._HostCoordinator)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return jax.tree_util.tree_unflatten(treedef, _fetch_leaves(leaves))
+
+
 def _path_str(p) -> str:
     for attr in ("key", "idx", "name"):
         if hasattr(p, attr):
@@ -27,11 +112,18 @@ def path_key(path) -> str:
 
 def flatten_pytree(tree, *, tag_bf16: bool = False) -> dict[str, np.ndarray]:
     """Pytree -> {path_key: np.ndarray}. With ``tag_bf16``, bfloat16 leaves
-    are stored as uint16 views under a tagged key (npz-safe)."""
+    are stored as uint16 views under a tagged key (npz-safe).
+
+    Collective when ``needs_collective_fetch(tree)``: leaves sharded across
+    processes (a model axis spanning hosts) are gathered with
+    ``process_allgather``, so every process must call this together —
+    the coordinated-checkpoint protocol in training/supervisor.py. The
+    device->host transfers for everything else batch into one call."""
+    paths_leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    fetched = _fetch_leaves([leaf for _, leaf in paths_leaves])
     flat = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+    for (path, _), arr in zip(paths_leaves, fetched):
         key = path_key(path)
-        arr = np.asarray(jax.device_get(leaf))
         if tag_bf16 and arr.dtype == jax.numpy.bfloat16:
             flat[_BF16_TAG + key] = arr.view(np.uint16)
         else:

@@ -19,6 +19,7 @@ Usage: python multihost_worker.py <step|train> <process_id> <num_processes> <por
 
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -36,10 +37,12 @@ def make_batch(i: int, n: int):
     return x, y
 
 
-def _init_cluster(process_id: int, num_processes: int, port: str):
-    # virtual 4-device CPU platform BEFORE backend init (conftest recipe:
+def _init_cluster(process_id: int, num_processes: int, port: str,
+                  local_devices: int = 4):
+    # virtual CPU platform BEFORE backend init (conftest recipe:
     # config-update beats a sitecustomize JAX_PLATFORMS pin, env alone loses)
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local_devices}")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -50,15 +53,16 @@ def _init_cluster(process_id: int, num_processes: int, port: str):
         process_id=process_id,
     )
     assert jax.process_count() == num_processes
-    assert jax.local_device_count() == 4
-    assert jax.device_count() == 4 * num_processes
+    assert jax.local_device_count() == local_devices
+    assert jax.device_count() == local_devices * num_processes
     return jax
 
 
 def run_train_loop(process_id: int, num_processes: int, port: str, outdir: str,
-                   extra_flags: tuple = ()) -> None:
+                   extra_flags: tuple = (), local_devices: int = 4,
+                   training_iter: int = 12) -> None:
     """Production path: flags + train(mode="sync") across 2 processes."""
-    jax = _init_cluster(process_id, num_processes, port)
+    jax = _init_cluster(process_id, num_processes, port, local_devices)
 
     from distributed_tensorflow_tpu import flags
     from distributed_tensorflow_tpu.training.loop import train
@@ -67,7 +71,7 @@ def run_train_loop(process_id: int, num_processes: int, port: str, outdir: str,
     flags.FLAGS._parse([
         f"--logdir={outdir}/logs",
         f"--data_dir={outdir}/no-data",  # forces synthetic
-        "--training_iter=12",
+        f"--training_iter={training_iter}",
         "--batch_size=32",
         "--display_step=4",
         "--optimizer=adam",
@@ -77,8 +81,8 @@ def run_train_loop(process_id: int, num_processes: int, port: str, outdir: str,
         *extra_flags,
     ])
     res = train(flags.FLAGS, mode="sync")
-    assert res.final_step == 12, res
-    assert res.n_chips == 4 * num_processes, res
+    assert res.final_step == training_iter, res
+    assert res.n_chips == local_devices * num_processes, res
     print(f"TRAIN_OK p{process_id} step={res.final_step}", flush=True)
     jax.distributed.shutdown()
 
@@ -95,6 +99,71 @@ def run_train_tp(process_id: int, num_processes: int, port: str, outdir: str) ->
     placed per-host via make_array_from_callback (shard_state_tp)."""
     run_train_loop(process_id, num_processes, port, outdir,
                    ("--model_axis=2",))
+
+
+def run_train_tp_span(process_id: int, num_processes: int, port: str,
+                      outdir: str) -> None:
+    """The round-2 latent crash shape: 2 processes x 2 devices with
+    --model_axis=4, so FC shards live on devices this process cannot
+    address and NO host holds full local coverage. Exercises the
+    coordinated checkpoint path end to end: the cadenced vote triggers a
+    mid-run collective save (save_model_secs=1 elapses during compile;
+    the first --coord_steps boundary lands it), and the managed-exit
+    final save gathers the spanning leaves via process_allgather."""
+    run_train_loop(process_id, num_processes, port, outdir,
+                   ("--model_axis=4", "--save_model_secs=1",
+                    "--coord_steps=4", "--eval_step=20"),
+                   local_devices=2, training_iter=40)
+
+
+def run_train_kill(process_id: int, num_processes: int, port: str,
+                   outdir: str) -> None:
+    """SIGTERM one host mid-run: the stop must propagate through the
+    cadenced vote so BOTH processes exit at the same agreed step and the
+    chief's final checkpoint lands at that step (the Supervisor
+    survive-and-checkpoint contract under the post-round-2 cadenced
+    protocol — no per-iteration allgather to lean on anymore)."""
+    import signal
+    import threading
+
+    jax = _init_cluster(process_id, num_processes, port)
+
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    flags.FLAGS._parse([
+        f"--logdir={outdir}/logs",
+        f"--data_dir={outdir}/no-data",
+        "--training_iter=20000",  # safety cap; the kill ends the run
+        "--batch_size=32",
+        "--display_step=10000",
+        "--model=mlp",  # fast CPU steps: the test targets the protocol
+        "--save_model_secs=100000",  # no cadenced saves: final save only
+        "--coord_steps=5",
+        "--test_eval=false",
+        f"--task_index={process_id}",
+    ])
+    if process_id == 1:
+        # the NON-chief gets the signal; only the vote can tell the chief.
+        # Fire only once training is observably underway (the chief's
+        # metrics file appears at the step-0 display, which both processes
+        # have synced past via the display eval's collective) — a fixed
+        # delay races managed()'s handler install and a SIGTERM landing
+        # before it hits whatever disposition the environment left.
+        metrics = os.path.join(outdir, "logs", "metrics.jsonl")
+
+        def _kill_when_training():
+            while not os.path.exists(metrics):
+                time.sleep(0.25)
+            time.sleep(2.0)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+        threading.Thread(target=_kill_when_training, daemon=True).start()
+    res = train(flags.FLAGS, mode="sync")
+    assert res.final_step < 20000, f"kill did not interrupt: {res}"
+    print(f"KILL_OK p{process_id} step={res.final_step}", flush=True)
+    jax.distributed.shutdown()
 
 
 def run(process_id: int, num_processes: int, port: str, outdir: str) -> None:
@@ -151,5 +220,7 @@ def run(process_id: int, num_processes: int, port: str, outdir: str) -> None:
 if __name__ == "__main__":
     mode = sys.argv[1]
     fn = {"step": run, "train": run_train_loop,
-          "train_device": run_train_device, "train_tp": run_train_tp}[mode]
+          "train_device": run_train_device, "train_tp": run_train_tp,
+          "train_tp_span": run_train_tp_span,
+          "train_kill": run_train_kill}[mode]
     fn(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5])

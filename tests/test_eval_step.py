@@ -79,3 +79,40 @@ def test_eval_step_off_by_default(tmp_path, capsys):
     train(flags.FLAGS, mode="local")
     out = capsys.readouterr().out
     assert re.findall(r"step: \d+ test accuracy: ", out) == []
+
+
+def test_eval_step_uses_validation_split(tmp_path, capsys):
+    """--validation_size routes the periodic evals to the carved-out
+    validation split (validation_* scalars, 'validation accuracy' lines);
+    the test split is evaluated only by the final --test_eval. Round-2
+    verdict: the split used to be carved out and then never consumed."""
+    F = _parse(tmp_path, "--validation_size=512")
+    res = train(F, mode="local")
+    out = capsys.readouterr().out
+    assert len(re.findall(r"step: \d+ validation accuracy: ", out)) == 3
+    assert re.findall(r"step: \d+ test accuracy: ", out) == []
+    # final end-of-run eval still reports the TEST split
+    assert res.test_metrics is not None
+    val_steps, test_steps = [], []
+    with open(f"{tmp_path}/logs/metrics.jsonl") as f:
+        for line in f:
+            rec = json.loads(line)
+            sc = rec.get("scalars", rec)
+            if "validation_accuracy" in sc:
+                val_steps.append(rec.get("step"))
+            if "test_accuracy" in sc:
+                test_steps.append(rec.get("step"))
+    assert val_steps == [10, 20, 30]
+    assert test_steps == [30]  # the final eval only
+
+
+def test_validation_split_shrinks_train(tmp_path):
+    """The held-out examples come out of the train split and are exposed
+    as ds.validation."""
+    from distributed_tensorflow_tpu.data import read_data_sets
+
+    full = read_data_sets(f"{tmp_path}/no-data", one_hot=True)
+    ds = read_data_sets(f"{tmp_path}/no-data", one_hot=True,
+                        validation_size=512)
+    assert ds.validation is not None and ds.validation.num_examples == 512
+    assert ds.train.num_examples == full.train.num_examples - 512

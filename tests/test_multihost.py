@@ -105,6 +105,66 @@ def test_tp_train_loop_multihost(tmp_path):
         assert "Optimization Finished!" in out, out[-2000:]
 
 
+def test_tp_spanning_checkpoint_multihost(tmp_path):
+    """--model_axis=4 over 2 procs x 2 devices: NO host holds full local
+    coverage of the FC shards (the round-2 latent-crash shape). The run
+    must train, land a cadenced mid-run checkpoint through the vote's
+    coordinated collective fetch, write the final checkpoint at exit, and
+    the result must be a complete GLOBAL params file --eval_only can
+    restore single-process."""
+    outs = _spawn_workers("train_tp_span", str(tmp_path))
+    for out in outs:
+        assert "TRAIN_OK" in out, out[-2000:]
+        assert "Optimization Finished!" in out, out[-2000:]
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        _all_steps,
+        latest_checkpoint,
+    )
+
+    logs = str(tmp_path / "logs")
+    found = latest_checkpoint(logs)
+    assert found is not None and found[1] == 40
+    # save_model_secs=1 elapsed during compile, so the first coord_steps
+    # boundary must have landed a mid-run save before the final one
+    assert any(s < 40 for s in _all_steps(logs)), _all_steps(logs)
+    # the spanning leaves were gathered into full global arrays: a fresh
+    # single-process --eval_only restores and measures the checkpoint
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "import runpy, sys;"
+        f"sys.argv = ['mnist_dist.py', '--eval_only', '--logdir={logs}',"
+        f" '--data_dir={tmp_path}/no-data'];"
+        "runpy.run_path('mnist_dist.py', run_name='__main__')"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, "PYTHONPATH": REPO,
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1"},
+        cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert '"step": 40' in r.stdout, r.stdout[-2000:]
+
+
+def test_kill_one_host_mid_run(tmp_path):
+    """SIGTERM the non-chief mid-run: with the cadenced vote (no
+    per-iteration allgather anymore) both processes must still exit at
+    the SAME agreed step and the chief's final checkpoint must land at
+    that step."""
+    import re
+
+    outs = _spawn_workers("train_kill", str(tmp_path))
+    steps = []
+    for out in outs:
+        assert "KILL_OK" in out, out[-2000:]
+        assert "Optimization Finished!" in out, out[-2000:]
+        steps.append(int(re.search(r"KILL_OK p\d+ step=(\d+)", out).group(1)))
+    assert steps[0] == steps[1], steps
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import latest_checkpoint
+
+    found = latest_checkpoint(str(tmp_path / "logs"))
+    assert found is not None and found[1] == steps[0], (found, steps)
+
+
 def test_params_identical_across_processes(multihost_params):
     """Replicated state must be bitwise identical on every host after 5
     steps — the sync-DP invariant (every process applies the same

@@ -44,3 +44,41 @@ def test_dtype_cast_to_template():
     tree = {"a": jnp.ones(2, jnp.float32)}
     out = unflatten_pytree(tree, {"a": np.ones(2, np.float64)})
     assert out["a"].dtype == np.float32
+
+
+def test_locally_fetchable_single_process():
+    """Single-process shapes: host arrays, plain device arrays, and
+    mesh-sharded arrays whose shards are all local are all fetchable
+    without a collective."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel import make_mesh
+    from distributed_tensorflow_tpu.utils.pytree import (
+        fetch_pytree,
+        locally_fetchable,
+        needs_collective_fetch,
+    )
+
+    mesh = make_mesh()
+    sharded = jax.device_put(jnp.arange(16.0),
+                             NamedSharding(mesh, P("data")))
+    tree = {"host": np.ones(3), "dev": jnp.ones(2), "sharded": sharded}
+    assert all(locally_fetchable(l) for l in jax.tree.leaves(tree))
+    assert not needs_collective_fetch(tree)
+    out = fetch_pytree(tree)
+    assert all(isinstance(l, np.ndarray) for l in jax.tree.leaves(out))
+    np.testing.assert_array_equal(out["sharded"], np.arange(16.0))
+
+
+def test_flatten_fetches_mesh_sharded_leaves():
+    """flatten_pytree must materialize mesh-sharded leaves to full host
+    arrays (the checkpoint path for sync/TP states)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    tree = {"w": jax.device_put(jnp.arange(8.0),
+                                NamedSharding(mesh, P("data")))}
+    flat = flatten_pytree(tree)
+    np.testing.assert_array_equal(flat["w"], np.arange(8.0))
