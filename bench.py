@@ -250,23 +250,31 @@ PS_BATCH = 128
 PS_STEPS = 30
 
 
-def ps_emulation_phase(ds) -> float:
+def ps_emulation_phase(ds, wire: str = "f32") -> float:
     """BASELINE config 5: the async parameter-server topology's cycle rate
-    (images/sec for ONE worker) — pull params over TCP, grads on the chip,
-    push back, ps-side SGD apply."""
+    (images/sec for ONE worker), running the product's DEFAULT sgd cycle
+    (--ps_mirror): params device-resident, grads pushed to the ps (which
+    applies ApplyGradientDescent parity), the identical sgd update applied
+    to the on-chip mirror, and the grad download+push software-pipelined
+    one step behind the chip (parallel/ps_emulation._mirror_train_loop —
+    trajectory-exact vs the serial pull cycle, tested). ``wire='bf16'``
+    additionally moves every tensor at half width over BOTH the TCP wire
+    and the host<->chip link (--ps_wire=bf16). Same-session A/B and the
+    cycle-segment profile live in PERF.md."""
     from distributed_tensorflow_tpu.models import DeepCNN
     from distributed_tensorflow_tpu.parallel.ps_emulation import (
+        MirrorCycle,
         PSClient,
         PSServer,
         assign_shards,
+        bf16_template,
         flatten_params,
         make_grad_fn,
-        unflatten_params,
     )
 
     server = PSServer(0, "127.0.0.1:0")
     server.start_background()
-    client = PSClient([server.address])
+    client = PSClient([server.address], wire=wire)
     try:
         model = DeepCNN()
         template = model.init(jax.random.PRNGKey(0))
@@ -275,21 +283,26 @@ def ps_emulation_phase(ds) -> float:
         client.init_params(flat, assignment, optimizer="sgd",
                            learning_rate=0.01)
         grad_fn = make_grad_fn(model, keep_prob=0.75,
-                               devices=jax.devices()[:1])
+                               devices=jax.devices()[:1], wire=wire)
+        compute_template = (bf16_template(template) if wire == "bf16"
+                            else template)
 
-        def cycle(rng):
-            cur, _ = client.pull_all()
-            params = unflatten_params(template, cur)
-            batch = ds.train.next_batch(PS_BATCH)
-            grads, m = grad_fn(params, batch, rng)
-            float(m["loss"])  # drain the device before the push
-            client.push_grads(flatten_params(grads), assignment)
-
+        # the PRODUCT's cycle object (run_worker drives the same class);
+        # resync cadence set beyond the phase so the steady-state
+        # zero-param-transfer cycle is what the clock sees
+        cyc = MirrorCycle(client, grad_fn, compute_template, assignment,
+                          learning_rate=0.01, resync_steps=10**9)
+        cyc.maybe_sync()  # initial pull + upload
         rng = jax.random.PRNGKey(1)
-        cycle(rng)  # warmup: compile + first program upload
+
+        def cycle(i):
+            cyc.run_cycle(ds.train.next_batch(PS_BATCH),
+                          jax.random.fold_in(rng, i))
+
+        cycle(10**6)  # warmup: compile + first program upload
         t0 = time.perf_counter()
         for i in range(PS_STEPS):
-            cycle(jax.random.fold_in(rng, i))
+            cycle(i)
         dt = time.perf_counter() - t0
         return PS_STEPS * PS_BATCH / dt
     finally:
@@ -436,6 +449,7 @@ def _run_phases():
     resnet, resnet_source = resnet_phase(n_chips)
     with _prng("threefry2x32"):
         ps_rate = ps_emulation_phase(ds)
+        ps_rate_bf16 = ps_emulation_phase(ds, wire="bf16")
 
     print(json.dumps({
         "metric": "mnist_images_per_sec_per_chip",
@@ -452,6 +466,7 @@ def _run_phases():
         "resnet20_cifar10_images_per_sec_per_chip": round(resnet, 1),
         "resnet_data_source": resnet_source,
         "ps_emulation_images_per_sec": round(ps_rate, 1),
+        "ps_emulation_bf16_images_per_sec": round(ps_rate_bf16, 1),
         **conv,
     }))
 

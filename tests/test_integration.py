@@ -250,3 +250,40 @@ def test_ps_cluster_multiprocess(tmp_path):
     finally:
         ps.kill()
         ps.wait()
+
+
+def test_ps_cluster_bf16_wire_serial_cycle(tmp_path):
+    """The same one-ps/two-worker cluster over the bf16 wire with the
+    serial (mirror-off, prefetch-off) full-pull cycle: the half-width
+    transport and the reference cycle ordering both train to completion.
+    --ps_mirror=false is load-bearing — without it the default sgd run
+    takes the mirror branch and the serial bf16 pull path goes untested."""
+    ps_addr = f"localhost:{_free_port()}"
+    common = [
+        f"--ps_hosts={ps_addr}", "--worker_hosts=localhost:1,localhost:2",
+        "--training_iter=12", "--batch_size=16", "--display_step=4",
+        f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+        "--learning_rate=0.01", "--save_model_secs=100000",
+        "--ps_wire=bf16", "--ps_prefetch=false", "--ps_mirror=false",
+    ]
+    ps = subprocess.Popen(
+        [sys.executable, "mnist_dist.py", "--job_name=ps", "--task_index=0", *common],
+        cwd=REPO, env=CPU_ENV, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        workers = [
+            subprocess.Popen(
+                [sys.executable, "mnist_dist.py", "--job_name=worker",
+                 f"--task_index={i}", *common],
+                cwd=REPO, env=CPU_ENV, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+            for i in range(2)
+        ]
+        for w in workers:
+            so, se = w.communicate(timeout=300)
+            assert w.returncode == 0, se[-2000:]
+            assert "Optimization Finished!" in so
+    finally:
+        ps.kill()
+        ps.wait()
