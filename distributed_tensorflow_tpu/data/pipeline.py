@@ -41,6 +41,16 @@ def prefetch_to_device(
     Worker exceptions propagate to the consumer (no silent end-of-stream),
     and closing the generator (break / .close()) unblocks and terminates
     the worker thread rather than leaking it on a full queue.
+
+    On the XLA:CPU backend the device placement happens on the CONSUMER
+    thread, not the worker: a background thread touching device APIs
+    while the main thread dispatches multi-device programs can deadlock
+    XLA:CPU's collective rendezvous (the device threads interleave
+    programs in different orders — PERF.md; observed as a hang in
+    ``block_until_ready`` under load). The worker then only assembles
+    host batches. TPU streams execute in enqueue order per chip, so the
+    worker stages directly there and the host->device copy overlaps the
+    running step — the behavior this pipeline exists for.
     """
     q: queue.Queue = queue.Queue(maxsize=size)
     stop = threading.Event()
@@ -50,6 +60,8 @@ def prefetch_to_device(
             if sharding is not None:
                 return jax.device_put(batch, sharding)
             return jax.device_put(batch)
+
+    stage_on_worker = jax.default_backend() != "cpu"
 
     def _send(item) -> bool:
         """put that gives up when the consumer has stopped."""
@@ -64,7 +76,8 @@ def prefetch_to_device(
     def _worker():
         try:
             for batch in it:
-                if stop.is_set() or not _send(stage(batch)):
+                item = stage(batch) if stage_on_worker else batch
+                if stop.is_set() or not _send(item):
                     return
             _send(_END)
         except BaseException as e:  # noqa: BLE001 — delivered to the consumer
@@ -82,7 +95,7 @@ def prefetch_to_device(
                 return
             if isinstance(item, BaseException):
                 raise item
-            yield item
+            yield item if stage_on_worker else stage(item)
     finally:
         stop.set()
         # drain so a blocked worker sees stop promptly

@@ -88,3 +88,12 @@ def test_bench_import_does_not_flip_global_prng():
     (changing init distributions under other tests' seeds). Assert the
     import left the impl exactly as it found it."""
     assert jax.config.jax_default_prng_impl == _PRNG_BEFORE_BENCH_IMPORT
+
+
+def test_convergence_phase_fashion_target(monkeypatch, ds):
+    """The fashion phase reuses convergence_phase with its own target and
+    budget; the reported target_accuracy must follow the parameter."""
+    monkeypatch.setattr(bench, "CONVERGE_EVAL_EVERY", 5)
+    out = bench.convergence_phase(ds, 1, target_acc=0.5, max_steps=20)
+    assert out["target_accuracy"] == 0.5
+    assert out["steps_to_target"] is None or out["steps_to_target"] <= 20
