@@ -1,7 +1,8 @@
 from distributed_tensorflow_tpu.models.cnn import DeepCNN
 from distributed_tensorflow_tpu.models.mlp import MLP
 from distributed_tensorflow_tpu.models.resnet import ResNet, ResNet20, ResNet32
+from distributed_tensorflow_tpu.models.transformer import MiniTransformer
 from distributed_tensorflow_tpu.models.registry import get_model, register_model
 
-__all__ = ["DeepCNN", "MLP", "ResNet", "ResNet20", "ResNet32", "get_model",
-           "register_model"]
+__all__ = ["DeepCNN", "MLP", "ResNet", "ResNet20", "ResNet32",
+           "MiniTransformer", "get_model", "register_model"]

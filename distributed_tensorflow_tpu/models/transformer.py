@@ -1,0 +1,173 @@
+"""MiniTransformer: an attention model family for the long-context path.
+
+The reference framework has no attention model — this is the build's
+extension exercising the sequence-parallel machinery
+(ops/attention.ring_attention + parallel/sequence_parallel) on the same
+datasets: an image is read as a SEQUENCE of rows (MNIST: 28 tokens of 28
+pixels; CIFAR-10: 32 tokens of 96), embedded, run through pre-LN
+transformer blocks, mean-pooled and classified. Pure pytree-of-arrays +
+``apply`` like every model here — jits, shards, grads as a function.
+
+Sequence parallelism: constructed with ``seq_axis="model"`` the model is
+SPMD-aware — called inside shard_map with the token dimension sharded
+over that mesh axis it slices its own positional embeddings by
+``lax.axis_index``, runs RING attention over the axis, and mean-pools
+with a ``psum``. Everything before the pool is per-token compute whose
+parameter gradients arrive as P-scaled partials per shard while the
+post-pool head's arrive replicated — one uniform pmean over the
+sequence axis reduces both exactly (see
+parallel/sequence_parallel.py for the derivation).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_tensorflow_tpu.models.cnn import truncated_normal_init
+from distributed_tensorflow_tpu.models.registry import register_model
+from distributed_tensorflow_tpu.ops import nn
+from distributed_tensorflow_tpu.ops.attention import (
+    multi_head_attention,
+    ring_attention,
+)
+
+
+def _layernorm(x, gain, bias, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = xf.var(axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * gain + bias).astype(x.dtype)
+
+
+@register_model("transformer")
+class MiniTransformer:
+    """Row-sequence transformer classifier.
+
+    ``seq_axis=None`` (default): dense attention, runs anywhere a
+    DeepCNN runs. ``seq_axis="model"``: ring attention + sharded
+    positional slices + psum pooling — must then be applied inside
+    shard_map with tokens sharded over that axis (the sequence-parallel
+    step builder does this).
+    """
+
+    stateful = False
+
+    def __init__(
+        self,
+        image_size: int = 28,
+        channels: int = 1,
+        num_classes: int = 10,
+        d_model: int = 128,
+        num_heads: int = 4,
+        num_blocks: int = 2,
+        mlp_ratio: int = 4,
+        compute_dtype: Any = None,
+        seq_axis: str | None = None,
+        **_unused,  # registry passes hidden_units etc. to every model
+    ):
+        if d_model % num_heads:
+            raise ValueError(f"d_model={d_model} % num_heads={num_heads} != 0")
+        self.image_size = image_size
+        self.channels = channels
+        self.num_classes = num_classes
+        self.d_model = d_model
+        self.num_heads = num_heads
+        self.num_blocks = num_blocks
+        self.mlp_dim = mlp_ratio * d_model
+        self.compute_dtype = compute_dtype
+        self.seq_axis = seq_axis
+        self.seq_len = image_size           # one token per image row
+        self.token_dim = image_size * channels
+
+    def init(self, key, dtype=jnp.float32):
+        d, h = self.d_model, self.num_heads
+        dh = d // h
+        keys = iter(jax.random.split(key, 4 + 7 * self.num_blocks))
+
+        def w(shape, stddev=0.02):
+            return truncated_normal_init(next(keys), shape, stddev, dtype)
+
+        params = {
+            "embed": {"w": w((self.token_dim, d)), "b": jnp.zeros((d,), dtype)},
+            "pos": w((self.seq_len, d)),
+            "blocks": [],
+            "ln_f": {"g": jnp.ones((d,), dtype), "b": jnp.zeros((d,), dtype)},
+            "head": {
+                "w": w((d, self.num_classes)),
+                "b": jnp.zeros((self.num_classes,), dtype),
+            },
+        }
+        for _ in range(self.num_blocks):
+            params["blocks"].append({
+                "ln1_g": jnp.ones((d,), dtype),
+                "ln1_b": jnp.zeros((d,), dtype),
+                "qkv": w((d, 3, h, dh)),
+                "proj": w((h * dh, d)),
+                "ln2_g": jnp.ones((d,), dtype),
+                "ln2_b": jnp.zeros((d,), dtype),
+                "mlp_in": {"w": w((d, self.mlp_dim)), "b": jnp.zeros((self.mlp_dim,), dtype)},
+                "mlp_out": {"w": w((self.mlp_dim, d)), "b": jnp.zeros((d,), dtype)},
+            })
+        return params
+
+    # ---- forward -------------------------------------------------------
+    def apply(self, params, x, *, keep_prob=1.0, rng=None, train: bool = False):
+        cd = self.compute_dtype
+        x = nn.normalize_if_u8(x, cd)
+        # (B, 784[*C]) or (B, S, token): accept both layouts. In SP mode
+        # x is the LOCAL token block (B, S/P, token) handed in by the
+        # shard_map step.
+        if x.ndim == 2:
+            x = x.reshape(-1, self.seq_len, self.token_dim)
+        if cd is not None:
+            x = x.astype(cd)
+
+        d = self.d_model
+        h = nn.dense(x, params["embed"]["w"], params["embed"]["b"],
+                     compute_dtype=cd)
+        pos = params["pos"]
+        if self.seq_axis is not None:
+            # my shard's slice of the positional table
+            s_local = x.shape[1]
+            start = lax.axis_index(self.seq_axis) * s_local
+            pos = lax.dynamic_slice_in_dim(pos, start, s_local, axis=0)
+        h = h + pos.astype(h.dtype)
+
+        for blk in params["blocks"]:
+            y = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
+            qkv = jnp.einsum("bsd,dthe->tbshe",
+                             y, blk["qkv"].astype(y.dtype))
+            q, k, v = qkv[0], qkv[1], qkv[2]
+            if self.seq_axis is not None:
+                a = ring_attention(q, k, v, self.seq_axis)
+            else:
+                a = multi_head_attention(q, k, v)
+            a = a.reshape(*a.shape[:2], -1)  # (B, S, H*Dh)
+            h = h + nn.dense(a, blk["proj"], compute_dtype=cd)
+            y = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
+            y = jax.nn.relu(nn.dense(y, blk["mlp_in"]["w"],
+                                     blk["mlp_in"]["b"], compute_dtype=cd))
+            h = h + nn.dense(y, blk["mlp_out"]["w"], blk["mlp_out"]["b"],
+                             compute_dtype=cd)
+
+        h = _layernorm(h, params["ln_f"]["g"], params["ln_f"]["b"])
+        # mean-pool over the FULL sequence: local sum, psum across the
+        # sequence shards, divide by the global length
+        pooled = h.sum(axis=1)
+        if self.seq_axis is not None:
+            pooled = lax.psum(pooled, self.seq_axis)
+        pooled = pooled / jnp.asarray(self.seq_len, pooled.dtype)
+        pooled = nn.dropout(pooled, keep_prob, rng, deterministic=not train)
+        logits = nn.dense(pooled, params["head"]["w"], params["head"]["b"],
+                          compute_dtype=cd)
+        return logits.astype(jnp.float32)
+
+    def num_params(self, params=None):
+        if params is None:
+            params = jax.eval_shape(lambda: self.init(jax.random.key(0)))
+        return sum(int(jnp.size(p)) for p in jax.tree.leaves(params))

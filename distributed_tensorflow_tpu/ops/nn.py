@@ -128,7 +128,10 @@ def softmax_cross_entropy(logits, labels):
         # batch 2048, vs ~nothing for the masked sum the VPU vectorizes
         # (PERF.md round 3). Same value, same gradient.
         onehot = jax.nn.one_hot(labels, logp.shape[-1], dtype=logp.dtype)
-        per_example = -jnp.sum(onehot * logp, axis=-1)
+        # where(), not onehot*logp: a masked class with logit -inf gives
+        # logp=-inf there, and 0 * -inf = NaN would poison the sum — the
+        # gather this replaces only ever read the label's entry
+        per_example = -jnp.sum(jnp.where(onehot != 0, logp, 0.0), axis=-1)
     else:
         per_example = -jnp.sum(labels.astype(jnp.float32) * logp, axis=-1)
     return jnp.mean(per_example)

@@ -1,0 +1,129 @@
+"""Sequence/context parallelism: shard the TOKEN axis over the mesh.
+
+The reference framework predates attention; this is the build's
+long-context machinery (meta-goal: sequence parallelism as a first-class
+mode). Layout: batch over the "data" axis, sequence over the "model"
+axis of the standard ("data", "model") mesh. Params replicate; inside
+``shard_map`` every device holds one (batch-slice, token-block) tile,
+attention runs as a RING over the sequence axis (one ppermute hop per
+step, k/v blocks rotating while queries stay — ops/attention), and the
+model mean-pools with a psum so the classifier head sees the full
+sequence. Peak per-device activation memory is one token block
+regardless of total sequence length — the property that makes long
+contexts fit at all.
+
+Gradient reduction is the subtle half: each sequence shard
+differentiates its own replicated copy of the loss and the pooled
+psum's transpose is itself a psum, so per-token parameter gradients
+arrive as their true partials scaled by the axis size P, while the
+post-pool head's gradients arrive bitwise-replicated — ONE uniform
+pmean over the sequence axis reduces both exactly (mean of P-scaled
+partials = the total; mean of replicas = identity). Then pmean over
+"data" as in ordinary sync DP, and every device applies the identical
+update so the replicated state stays in sync. Exactness vs the dense
+single-device step is pinned by tests/test_attention.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from distributed_tensorflow_tpu.training.train_state import (
+    TrainState,
+    apply_updates,
+    compute_grads,
+    loss_and_metrics,
+)
+
+
+def stage_batch_sp(mesh, batch):
+    """(x, y) host batch -> device arrays with x (B, S, token) tiled
+    (batch over "data", tokens over "model") and labels batch-sharded."""
+    x, y = batch
+    return (
+        jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))),
+        jax.device_put(y, NamedSharding(mesh, P(DATA_AXIS))),
+    )
+
+
+def reshape_for_sp(model, x):
+    """Flat (B, F) pixels -> (B, S, token) BEFORE staging, so the token
+    axis exists to shard."""
+    return jnp.asarray(x).reshape(-1, model.seq_len, model.token_dim)
+
+
+def make_sp_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
+                       donate: bool = True):
+    """Compiled sequence-parallel train step: (state, staged batch) ->
+    (state, metrics).
+
+    ``model`` must be constructed with ``seq_axis=MODEL_AXIS`` (it then
+    ring-attends and psum-pools over that axis). State (params + opt
+    slots) replicates.
+    """
+    if getattr(model, "seq_axis", None) != MODEL_AXIS:
+        raise ValueError(
+            f"model.seq_axis must be {MODEL_AXIS!r} for the SP step "
+            f"(got {getattr(model, 'seq_axis', None)!r})")
+
+    def per_shard(state: TrainState, batch):
+        rng, sub = jax.random.split(state.rng)
+        # dropout runs on the REPLICATED post-pool path: the mask must be
+        # identical across sequence shards (distinct only per data shard)
+        # or the replicated head computation diverges between shards
+        sub = jax.random.fold_in(sub, lax.axis_index(DATA_AXIS))
+
+        grads, shard_metrics, model_state = compute_grads(
+            model, state.params, batch, keep_prob=keep_prob, rng=sub,
+            model_state=state.model_state,
+        )
+        # ONE uniform pmean over the sequence axis is exact for EVERY
+        # parameter: per-token params (embeddings, block weights) carry
+        # their true partial contribution scaled by P — each of the P
+        # sequence shards differentiates its own replicated copy of the
+        # loss, and the pooled psum's transpose is itself a psum,
+        # multiplying every pre-pool cotangent by P — so
+        # pmean = (1/P) * sum(P * partial_i) = the exact total. Post-pool
+        # (head) params see the replicated pooled vector and identical
+        # labels/dropout, so their grads are already bitwise-replicated
+        # across sequence shards and pmean is the identity.
+        # tests/test_attention.py pins the trajectory equivalence.
+        grads = lax.pmean(grads, MODEL_AXIS)
+        grads = lax.pmean(grads, DATA_AXIS)
+        metrics = lax.pmean(shard_metrics, DATA_AXIS)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params, state.step)
+        params = apply_updates(state.params, updates)
+        return (TrainState(params, opt_state, state.step + 1, rng,
+                           model_state), metrics)
+
+    sharded = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), (P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS))),
+        out_specs=(P(), P()),
+        check_vma=False,  # rng ops + replicated-out pattern
+    )
+    if donate:
+        return jax.jit(sharded, donate_argnums=(0,))
+    return jax.jit(sharded)
+
+
+def make_sp_eval_step(model, mesh):
+    """Dropout-off metrics over the SP layout, pmean'd over "data"."""
+    def per_shard(params, batch):
+        _, aux = loss_and_metrics(model, params, batch, train=False)
+        return lax.pmean(aux["metrics"], DATA_AXIS)
+
+    sharded = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), (P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS))),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -94,3 +94,22 @@ def test_dropout_keep_prob_one_is_identity_valued():
     x = jax.random.normal(jax.random.key(1), (32, 16))
     y = nn.dropout(x, 1.0, jax.random.key(2), deterministic=False)
     np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6)
+
+
+def test_int_label_ce_matches_onehot_and_tolerates_masked_logits():
+    """Integer-label CE (the one-hot contraction that replaced the
+    TPU-hostile take_along_axis gather) must equal the one-hot path, and
+    a -inf-masked non-label logit must not poison the loss with NaN
+    (0 * -inf hazard — the where() guard)."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(8, 10)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 10, 8).astype(np.int32))
+    onehot = jax.nn.one_hot(labels, 10)
+    np.testing.assert_allclose(
+        float(nn.softmax_cross_entropy(logits, labels)),
+        float(nn.softmax_cross_entropy(logits, onehot)), rtol=1e-6)
+
+    masked = logits.at[:, 3].set(-jnp.inf)
+    labels_safe = jnp.where(labels == 3, 4, labels).astype(jnp.int32)
+    loss = float(nn.softmax_cross_entropy(masked, labels_safe))
+    assert np.isfinite(loss), loss
