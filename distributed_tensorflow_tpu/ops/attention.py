@@ -24,20 +24,25 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def multi_head_attention(q, k, v):
-    """Dense (all-to-all) bidirectional multi-head attention.
+def multi_head_attention(q, k, v, causal: bool = False):
+    """Dense (all-to-all) multi-head attention.
 
     q, k, v: (B, S, H, Dh) -> (B, S, H, Dh). f32 softmax statistics
-    regardless of input dtype (bf16-safe).
+    regardless of input dtype (bf16-safe). ``causal`` masks j > i (the
+    autoregressive/LM form).
     """
     dh = q.shape[-1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     s = s / jnp.sqrt(jnp.float32(dh))
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None]
+        s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 
 
-def ring_attention(q, k, v, axis_name: str):
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     """Ring attention over the mesh axis ``axis_name`` (sequence-sharded).
 
     Call INSIDE shard_map with the sequence dimension of q/k/v sharded
@@ -45,9 +50,17 @@ def ring_attention(q, k, v, axis_name: str):
     Each of the P ring steps attends the local queries against the
     currently-held k/v block, folds the result into the online-softmax
     accumulators (running max m, denominator l, numerator o), and passes
-    the k/v block to the next device (``ppermute``). After P steps every
-    query has seen every key exactly once; the result equals dense
-    attention over the gathered sequence (tested to fp tolerance).
+    the k/v block to the next device (``ppermute``; P-1 hops — the local
+    block is consumed before the scan). After P steps every query has
+    seen every key exactly once; the result equals dense attention over
+    the gathered sequence (tested to fp tolerance).
+
+    ``causal=True`` masks by GLOBAL token position: at ring step t this
+    device holds the k/v block of shard (me - t) mod P, so the mask
+    compares (my_shard * Sq + i) against (owner * Sk + j) — the
+    blockwise form of the LM triangle. Attending the local block first
+    guarantees the running max is finite from step one (the diagonal is
+    never masked), so fully-masked later blocks contribute exact zeros.
     """
     p_size = lax.axis_size(axis_name)
     dh = q.shape[-1]
@@ -57,10 +70,16 @@ def ring_attention(q, k, v, axis_name: str):
     # arithmetic; f32 keeps the rescaling stable for bf16 inputs
     qf = q.astype(jnp.float32)
     perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+    me = lax.axis_index(axis_name)
+    row_global = me * sq + jnp.arange(sq)  # my queries' global positions
 
-    def attend(o, m, l, k_blk, v_blk):
+    def attend(o, m, l, k_blk, v_blk, owner):
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
         s = s * scale  # (B, H, Sq, Skb)
+        if causal:
+            col_global = owner * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
+            mask = col_global[None, :] <= row_global[:, None]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
         m_new = jnp.maximum(m, s.max(axis=-1))
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
@@ -69,22 +88,23 @@ def ring_attention(q, k, v, axis_name: str):
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
         return o, m_new, l
 
-    def ring_step(carry, _):
+    def ring_step(carry, t):
         # rotate FIRST, then attend: the locally-held block is consumed
         # outside the scan, so exactly P-1 ICI hops happen (a trailing
         # rotation whose output nobody reads would not be DCE'd out of
-        # the compiled loop)
+        # the compiled loop). After t rotations this device holds the
+        # block ORIGINALLY owned by shard (me - t) mod P.
         o, m, l, k_blk, v_blk = carry
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        o, m, l = attend(o, m, l, k_blk, v_blk)
+        o, m, l = attend(o, m, l, k_blk, v_blk, (me - t) % p_size)
         return (o, m, l, k_blk, v_blk), None
 
     o0 = jnp.zeros((b, h, sq, dh), jnp.float32)
     m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, sq), jnp.float32)
-    o, m, l = attend(o0, m0, l0, k, v)
+    o, m, l = attend(o0, m0, l0, k, v, me)
     (o, _, l, _, _), _ = lax.scan(
-        ring_step, (o, m, l, k, v), None, length=p_size - 1)
+        ring_step, (o, m, l, k, v), jnp.arange(1, p_size))
     out = o / l[..., None]
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)

@@ -41,15 +41,16 @@ def _qkv(key, b=2, s=16, h=2, dh=8):
     return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
 
 
-def test_ring_equals_dense_attention():
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_equals_dense_attention(causal):
     """Ring attention over a sharded sequence == dense attention on the
-    gathered sequence (forward)."""
+    gathered sequence (forward), bidirectional and causal."""
     q, k, v = _qkv(jax.random.PRNGKey(0))
-    dense = multi_head_attention(q, k, v)
+    dense = multi_head_attention(q, k, v, causal=causal)
 
     mesh = make_mesh(MeshSpec(data=1, model=8))
     ring = jax.jit(jax.shard_map(
-        lambda q, k, v: ring_attention(q, k, v, MODEL_AXIS),
+        lambda q, k, v: ring_attention(q, k, v, MODEL_AXIS, causal=causal),
         mesh=mesh,
         in_specs=(P(None, MODEL_AXIS), P(None, MODEL_AXIS), P(None, MODEL_AXIS)),
         out_specs=P(None, MODEL_AXIS),
@@ -59,7 +60,8 @@ def test_ring_equals_dense_attention():
                                rtol=2e-5, atol=2e-6)
 
 
-def test_ring_attention_grads_match_dense():
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_grads_match_dense(causal):
     """Gradients THROUGH the ring (ppermute transpose chain) equal the
     dense gradients; per-shard q/k/v grads are per-token partials, so
     they compare directly after the same sharding."""
@@ -67,7 +69,7 @@ def test_ring_attention_grads_match_dense():
     w = jax.random.normal(jax.random.PRNGKey(2), q.shape, jnp.float32)
 
     def dense_loss(qkv):
-        return (multi_head_attention(*qkv) * w).sum()
+        return (multi_head_attention(*qkv, causal=causal) * w).sum()
 
     g_dense = jax.grad(dense_loss)((q, k, v))
 
@@ -79,7 +81,7 @@ def test_ring_attention_grads_match_dense():
         # back through the ppermute transpose chain — both exactly the
         # dense partials. (A psum'd replicated loss would scale every
         # grad by the axis size: each shard differentiates its own copy.)
-        out = ring_attention(*qkv, MODEL_AXIS)
+        out = ring_attention(*qkv, MODEL_AXIS, causal=causal)
         return (out * w).sum()
 
     g_ring = jax.jit(jax.shard_map(
