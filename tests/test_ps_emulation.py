@@ -475,3 +475,49 @@ def test_mirror_resync_cadence(tmp_path):
     for k in resync:
         np.testing.assert_allclose(resync[k], baseline[k], rtol=2e-5,
                                    atol=1e-6, err_msg=k)
+
+
+def test_mirror_cycle_desyncs_on_foreign_push():
+    """MirrorCycle must detect another worker's interleaved push (the
+    global step skips ahead) and resync from the ps instead of trusting
+    its on-chip replay."""
+    from distributed_tensorflow_tpu.parallel.ps_emulation import MirrorCycle
+
+    server = PSServer(0, "127.0.0.1:0")
+    server.start_background()
+    client = PSClient([server.address])
+    rogue = PSClient([server.address])
+    try:
+        model = DeepCNN()
+        template = model.init(jax.random.PRNGKey(0))
+        flat = flatten_params(template)
+        assignment = assign_shards(list(flat), 1)
+        client.init_params(flat, assignment, optimizer="sgd",
+                           learning_rate=0.1)
+        grad_fn = make_grad_fn(model, keep_prob=1.0,
+                               devices=jax.devices()[:1])
+        cyc = MirrorCycle(client, grad_fn, template, assignment,
+                          learning_rate=0.1, resync_steps=10**6)
+        assert cyc.maybe_sync()
+
+        rng = jax.random.PRNGKey(1)
+        x = np.random.default_rng(0).random((8, 784)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[np.arange(8) % 10]
+        cyc.run_cycle((x, y), rng)          # pending grad, no push yet
+        cyc.run_cycle((x, y), rng)          # pushes cycle 1 -> step 1
+        assert cyc.step == 1 and not cyc.needs_resync
+
+        # another worker's push lands between our cycles
+        rogue.push_grads({k: np.zeros_like(v) for k, v in flat.items()},
+                         assignment)
+        cyc.run_cycle((x, y), rng)          # our push sees step jump 1->3
+        assert cyc.step == 3
+        assert cyc.needs_resync             # foreign update detected
+        # resync drains the trailing grad (step -> 4), then pulls the
+        # fresh authority
+        assert cyc.maybe_sync()
+        assert not cyc.needs_resync and cyc.mirror_step == cyc.step == 4
+    finally:
+        client.close()
+        rogue.close()
+        server.close()
