@@ -157,3 +157,37 @@ def test_transformer_registry_and_local_training():
         if first is None:
             first = float(m["loss"])
     assert float(m["loss"]) < first
+
+
+def test_transformer_composes_with_dp_and_device_steps():
+    """The dense transformer is a pure model function, so the existing
+    sync-DP shard_map step and the device-resident chunked step must both
+    drive it unchanged (the composition the mode matrix promises)."""
+    from distributed_tensorflow_tpu.data.device_data import DeviceData
+    from distributed_tensorflow_tpu.parallel import make_dp_train_step, shard_batch
+    from distributed_tensorflow_tpu.parallel.data_parallel import replicate_state
+    from distributed_tensorflow_tpu.training import adam
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_device_train_step,
+    )
+
+    model = MiniTransformer(**KW)
+    opt = adam(1e-3)
+
+    mesh = make_mesh(MeshSpec(data=8, model=1))
+    state = replicate_state(mesh, create_train_state(model, opt, seed=0))
+    step = make_dp_train_step(model, opt, mesh, keep_prob=0.9, donate=False)
+    x = jax.random.uniform(jax.random.PRNGKey(0), (16, 784))
+    y = jax.nn.one_hot(jnp.arange(16) % 10, 10)
+    state, m = step(state, shard_batch(mesh, (x, y)))
+    assert np.isfinite(float(m["loss"])) and int(state.step) == 1
+
+    # the production pairing: single-device builder with plain arrays
+    # (loop.py hands mesh-replicated data to make_device_dp_train_step)
+    data = DeviceData(jnp.zeros((64, 784), jnp.uint8),
+                      jnp.arange(64, dtype=jnp.int32) % 10)
+    dstate = create_train_state(model, opt, seed=0)
+    dstep = make_device_train_step(model, opt, 16, keep_prob=0.9, chunk=2,
+                                   donate=False)
+    dstate, dm = dstep(dstate, data)
+    assert np.isfinite(float(dm["loss"])) and int(dstate.step) == 2
