@@ -203,7 +203,15 @@ def define_reference_flags():
     DEFINE_integer("model_axis", 1, "Tensor-parallel ways on the mesh's "
                    "'model' axis (sync mode): the CNN's FC stack is "
                    "column/row-split and XLA inserts the collectives. "
-                   "1 = pure data parallelism (reference-equivalent)")
+                   "1 = pure data parallelism (reference-equivalent). "
+                   "With --seq_parallel this is the SEQUENCE ways instead")
+    DEFINE_boolean("seq_parallel", False, "Sequence/context parallelism "
+                   "(sync mode, --model transformer only): the token axis "
+                   "shards --model_axis ways over the mesh's 'model' axis, "
+                   "attention runs as a RING (k/v blocks rotating over "
+                   "ICI with online-softmax accumulation), per-device "
+                   "activation memory stays one token block regardless "
+                   "of context length")
     DEFINE_string("lr_schedule", "constant", "Learning-rate schedule: "
                   "constant|cosine|linear|exponential — evaluated inside "
                   "the compiled step (reference: constant). Decays over "

@@ -790,6 +790,9 @@ def ps_unsupported_flag_error(FLAGS) -> str | None:
     if getattr(FLAGS, "ps_wire", "f32") not in ("f32", "bf16"):
         return (f"--ps_wire must be 'f32' or 'bf16', got "
                 f"{getattr(FLAGS, 'ps_wire')!r}")
+    if getattr(FLAGS, "seq_parallel", False):
+        return ("--seq_parallel is not supported in ps mode (sequence "
+                "parallelism needs the sync mesh); use --mode=sync")
     return None
 
 

@@ -114,16 +114,24 @@ def make_sp_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
 
 
 def make_sp_eval_step(model, mesh):
-    """Dropout-off metrics over the SP layout, pmean'd over "data"."""
+    """Dropout-off metrics over the SP layout, pmean'd over "data".
+
+    Accepts (and ignores) a trailing ``model_state`` so the training
+    loop can call every mode's eval step with one signature (the
+    transformer is stateless)."""
     def per_shard(params, batch):
         _, aux = loss_and_metrics(model, params, batch, train=False)
         return lax.pmean(aux["metrics"], DATA_AXIS)
 
-    sharded = jax.shard_map(
+    sharded = jax.jit(jax.shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(P(), (P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS))),
         out_specs=P(),
         check_vma=False,
-    )
-    return jax.jit(sharded)
+    ))
+
+    def eval_step(params, batch, model_state=()):
+        return sharded(params, batch)
+
+    return eval_step

@@ -146,7 +146,89 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 f"--batch_size={FLAGS.batch_size} must be divisible by "
                 f"--accum_steps={accum}"
             )
-    if mode == "sync" and model_axis > 1:
+    if getattr(FLAGS, "seq_parallel", False):
+        # sequence/context parallelism: tokens sharded --model_axis ways,
+        # ring attention over the mesh's "model" axis
+        # (parallel/sequence_parallel.py). The training step runs an
+        # SP-aware twin of the model; the DENSE model built above keeps
+        # serving every host-side eval path (identical params and math —
+        # ring == dense is pinned by tests/test_attention.py), since an
+        # SP model cannot apply outside shard_map (lax.axis_index).
+        from distributed_tensorflow_tpu.models.transformer import (
+            MiniTransformer,
+        )
+        from distributed_tensorflow_tpu.parallel import MeshSpec
+        from distributed_tensorflow_tpu.parallel.mesh import (
+            DATA_AXIS,
+            MODEL_AXIS,
+        )
+        from distributed_tensorflow_tpu.parallel.sequence_parallel import (
+            make_sp_eval_step,
+            make_sp_train_step,
+            reshape_for_sp,
+            stage_batch_sp,
+        )
+
+        if not isinstance(model, MiniTransformer):
+            raise ValueError(
+                f"--seq_parallel requires --model transformer (an "
+                f"attention model with a token axis to shard); got "
+                f"--model {FLAGS.model!r}")
+        if mode != "sync":
+            raise ValueError(
+                "--seq_parallel requires sync mode (a device mesh); "
+                "use --mode=sync")
+        if model_axis < 2:
+            raise ValueError(
+                f"--seq_parallel shards the sequence --model_axis ways; "
+                f"--model_axis={model_axis} shards nothing (use >= 2)")
+        if model.seq_len % model_axis:
+            raise ValueError(
+                f"sequence length {model.seq_len} must divide into "
+                f"--model_axis={model_axis} token blocks")
+        for flag, why in (
+            ("device_data", "the device-resident sampler has no token "
+                            "sharding"),
+            ("augment", "augmentation expects the image layout"),
+        ):
+            if getattr(FLAGS, flag, False):
+                raise ValueError(f"--{flag} is not supported with "
+                                 f"--seq_parallel ({why})")
+        if accum > 1:
+            raise ValueError("--accum_steps>1 is not supported with "
+                             "--seq_parallel")
+        if clip is not None:
+            raise ValueError("--clip_norm is not supported with "
+                             "--seq_parallel")
+        if n_procs > 1:
+            raise ValueError(
+                "--seq_parallel is single-process for now: stage_batch_sp "
+                "has no per-host slice assembly (the "
+                "make_array_from_process_local_data path DP/TP staging "
+                "uses); run on one host's chips")
+
+        sp_model = MiniTransformer(
+            image_size=model.image_size, channels=model.channels,
+            num_classes=model.num_classes, d_model=model.d_model,
+            num_heads=model.num_heads, num_blocks=model.num_blocks,
+            mlp_ratio=model.mlp_dim // model.d_model,
+            compute_dtype=model.compute_dtype, seq_axis=MODEL_AXIS)
+        mesh = make_mesh(MeshSpec(data=-1, model=model_axis))
+        n_chips = mesh.devices.size
+        data_ways = mesh.shape[DATA_AXIS]
+        if FLAGS.batch_size % data_ways:
+            raise ValueError(
+                f"--batch_size={FLAGS.batch_size} must be divisible by "
+                f"the {data_ways}-way data axis")
+        feed_batch = local_batch_size(FLAGS.batch_size)
+        state = replicate_state(mesh, state)
+        step_fn = make_sp_train_step(sp_model, opt, mesh,
+                                     keep_prob=FLAGS.keep_prob)
+        eval_fn = make_sp_eval_step(sp_model, mesh)
+        stage = lambda b: stage_batch_sp(
+            mesh, (reshape_for_sp(sp_model, b[0]), b[1]))
+        restage = lambda s: replicate_state(mesh, s)
+    elif mode == "sync" and model_axis > 1:
         # tensor parallelism (+DP on the remaining devices): GSPMD layout,
         # XLA inserts the collectives — parallel/tensor_parallel.py
         from distributed_tensorflow_tpu.parallel import MeshSpec
