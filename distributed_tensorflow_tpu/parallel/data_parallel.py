@@ -42,21 +42,12 @@ def shard_batch(mesh, batch):
     via ``jax.make_array_from_process_local_data`` — each host uploads only
     to its own chips, no cross-host data movement.
     """
-    x, y = batch
-    if jax.process_count() > 1:
-        import numpy as np
+    from distributed_tensorflow_tpu.parallel.mesh import put_global
 
-        return (
-            jax.make_array_from_process_local_data(
-                batch_sharding(mesh, x.ndim), np.asarray(x)
-            ),
-            jax.make_array_from_process_local_data(
-                batch_sharding(mesh, y.ndim), np.asarray(y)
-            ),
-        )
-    return (
-        jax.device_put(x, batch_sharding(mesh, x.ndim)),
-        jax.device_put(y, batch_sharding(mesh, y.ndim)),
+    x, y = batch
+    return put_global(
+        (batch_sharding(mesh, x.ndim), batch_sharding(mesh, y.ndim)),
+        (x, y),
     )
 
 

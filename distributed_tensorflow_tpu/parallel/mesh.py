@@ -67,3 +67,24 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 def batch_sharding(mesh: Mesh, batch_axes: int = 1) -> NamedSharding:
     """Inputs: leading dim split over the data axis, rest replicated."""
     return NamedSharding(mesh, P(DATA_AXIS, *([None] * (batch_axes - 1))))
+
+
+def put_global(shardings, arrays):
+    """Arrays -> device arrays laid out per ``shardings`` (one per array).
+
+    The ONE implementation of the single- vs multi-process staging
+    decision shared by every parallel mode's input path (DP/TP
+    ``shard_batch``, SP ``stage_batch_sp``): single-process is a plain
+    ``device_put``; multi-process treats each array as THIS process's
+    local slice and assembles the global array via
+    ``make_array_from_process_local_data`` — each host uploads only to
+    its own chips, no cross-host data movement."""
+    import jax
+    import numpy as np
+
+    if jax.process_count() > 1:
+        return tuple(
+            jax.make_array_from_process_local_data(s, np.asarray(a))
+            for s, a in zip(shardings, arrays)
+        )
+    return tuple(jax.device_put(a, s) for s, a in zip(shardings, arrays))

@@ -42,18 +42,31 @@ from distributed_tensorflow_tpu.training.train_state import (
 
 def stage_batch_sp(mesh, batch):
     """(x, y) host batch -> device arrays with x (B, S, token) tiled
-    (batch over "data", tokens over "model") and labels batch-sharded."""
+    (batch over "data", tokens over "model") and labels batch-sharded.
+
+    Multi-process: ``batch`` is this process's LOCAL slice of the global
+    batch with the FULL token axis (the "model"/sequence axis must stay
+    within each host — the loop guards this); slices assemble into one
+    global-mesh array via ``make_array_from_process_local_data``, each
+    host uploading only to its own chips, exactly like DP/TP staging."""
+    from distributed_tensorflow_tpu.parallel.mesh import put_global
+
     x, y = batch
-    return (
-        jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))),
-        jax.device_put(y, NamedSharding(mesh, P(DATA_AXIS))),
+    return put_global(
+        (NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS)),
+         NamedSharding(mesh, P(DATA_AXIS))),
+        (x, y),
     )
 
 
 def reshape_for_sp(model, x):
     """Flat (B, F) pixels -> (B, S, token) BEFORE staging, so the token
-    axis exists to shard."""
-    return jnp.asarray(x).reshape(-1, model.seq_len, model.token_dim)
+    axis exists to shard. A host-side numpy view — staging does the one
+    upload (a jnp reshape here would bounce the batch host->device->host
+    on the hot input path)."""
+    import numpy as np
+
+    return np.asarray(x).reshape(-1, model.seq_len, model.token_dim)
 
 
 def make_sp_train_step(model, optimizer, mesh, keep_prob: float = 1.0,

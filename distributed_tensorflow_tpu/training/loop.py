@@ -200,12 +200,6 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         if clip is not None:
             raise ValueError("--clip_norm is not supported with "
                              "--seq_parallel")
-        if n_procs > 1:
-            raise ValueError(
-                "--seq_parallel is single-process for now: stage_batch_sp "
-                "has no per-host slice assembly (the "
-                "make_array_from_process_local_data path DP/TP staging "
-                "uses); run on one host's chips")
 
         sp_model = MiniTransformer(
             image_size=model.image_size, channels=model.channels,
@@ -214,6 +208,21 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             mlp_ratio=model.mlp_dim // model.d_model,
             compute_dtype=model.compute_dtype, seq_axis=MODEL_AXIS)
         mesh = make_mesh(MeshSpec(data=-1, model=model_axis))
+        if n_procs > 1:
+            # the token ("model") axis must stay within a host: staging
+            # feeds each process its batch slice with the FULL token
+            # axis. Check the MESH rows directly — on real TPU slices
+            # device ids follow physical topology, so a size comparison
+            # against local_device_count can pass while a row still
+            # mixes processes.
+            for row in mesh.devices:
+                if len({d.process_index for d in row}) != 1:
+                    raise ValueError(
+                        f"--seq_parallel with --model_axis={model_axis} "
+                        f"puts devices from multiple hosts on one token-"
+                        f"axis row of the mesh; each host must hold the "
+                        f"full sequence — use a model_axis whose rows "
+                        f"stay within one host's chips")
         n_chips = mesh.devices.size
         data_ways = mesh.shape[DATA_AXIS]
         if FLAGS.batch_size % data_ways:

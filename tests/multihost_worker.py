@@ -166,6 +166,17 @@ def run_train_kill(process_id: int, num_processes: int, port: str,
     jax.distributed.shutdown()
 
 
+def run_train_sp(process_id: int, num_processes: int, port: str,
+                 outdir: str) -> None:
+    """--seq_parallel across 2 processes: batch sliced per host (data
+    axis spans processes), the token axis sharded within each host's 4
+    devices, ring attention over the global mesh's "model" axis, batch
+    slices assembled via make_array_from_process_local_data."""
+    run_train_loop(process_id, num_processes, port, outdir,
+                   ("--seq_parallel", "--model=transformer",
+                    "--model_axis=4"))
+
+
 def run(process_id: int, num_processes: int, port: str, outdir: str) -> None:
     jax = _init_cluster(process_id, num_processes, port)
 
@@ -222,5 +233,6 @@ if __name__ == "__main__":
     fn = {"step": run, "train": run_train_loop,
           "train_device": run_train_device, "train_tp": run_train_tp,
           "train_tp_span": run_train_tp_span,
+          "train_sp": run_train_sp,
           "train_kill": run_train_kill}[mode]
     fn(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5])

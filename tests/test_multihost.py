@@ -145,6 +145,20 @@ def test_tp_spanning_checkpoint_multihost(tmp_path):
     assert '"step": 40' in r.stdout, r.stdout[-2000:]
 
 
+def test_sp_train_loop_multihost(tmp_path):
+    """--seq_parallel across 2 processes: per-host batch slices assembled
+    onto the global mesh, ring attention over the within-host token
+    axis, the cadenced vote, and the chief's final checkpoint."""
+    outs = _spawn_workers("train_sp", str(tmp_path))
+    for out in outs:
+        assert "TRAIN_OK" in out, out[-2000:]
+        assert "Optimization Finished!" in out, out[-2000:]
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import latest_checkpoint
+
+    found = latest_checkpoint(str(tmp_path / "logs"))
+    assert found is not None and found[1] == 12
+
+
 def test_kill_one_host_mid_run(tmp_path):
     """SIGTERM the non-chief mid-run: with the cadenced vote (no
     per-iteration allgather anymore) both processes must still exit at
