@@ -290,7 +290,9 @@ class PSServer:
         self.task_index = task_index
         host, port = bind_address.rsplit(":", 1)
         self._lock = threading.Lock()
-        self._applied_seq: dict[str, int] = {}  # push dedup per worker
+        self._applied_seq: dict[str, int] = {}  # push dedup per worker (LRU)
+        self.dedup_cap = 1024  # raised by init_shard's num_workers
+        self._evictions = 0
         self.drop_reply_once: set[str] = set()  # test fault injection
         self.params: dict[str, np.ndarray] = {}
         self.optimizer: _PsOptimizer | None = None
@@ -334,6 +336,12 @@ class PSServer:
                     return {"ok": False, "error": str(e)}
                 self.params = {k: np.array(v, dtype=np.float32)
                                for k, v in msg["params"].items()}
+                # dedup capacity scales with the declared deployment so a
+                # cluster larger than the default can never evict a live
+                # worker's entry (ADVICE r3: active-but-slow worker eviction)
+                n_workers = msg.get("num_workers")
+                if n_workers:
+                    self.dedup_cap = max(self.dedup_cap, 4 * int(n_workers))
                 self.initialized = True
                 return {"ok": True}
             if op == "pull":
@@ -362,17 +370,38 @@ class PSServer:
                 worker, seq = msg.get("worker"), msg.get("seq")
                 if worker is not None and seq is not None:
                     if seq <= self._applied_seq.get(worker, -1):
+                        # a dedup HIT proves the worker is alive (it just
+                        # retried) — refresh its recency so a slow-but-live
+                        # worker is never the eviction victim below. Guard
+                        # the refresh: a malformed negative seq matches the
+                        # -1 default for a worker with NO entry to refresh.
+                        if worker in self._applied_seq:
+                            self._applied_seq[worker] = (
+                                self._applied_seq.pop(worker))
                         return {"ok": True, "global_step": self.global_step,
                                 "duplicate": True}
                     # bound the dedup table: one entry per client
                     # incarnation would otherwise grow forever on a
-                    # long-lived ps serving crash-looping workers. LRU by
-                    # insertion refresh; the cap far exceeds any plausible
-                    # live worker count, so eviction only drops incarnations
-                    # that stopped pushing long ago.
+                    # long-lived ps serving crash-looping workers. Evicts
+                    # LEAST-RECENTLY-USED (both applies and dedup hits
+                    # refresh recency), and the cap scales with the
+                    # declared cluster size, so eviction only drops
+                    # incarnations that stopped pushing long ago — never
+                    # an active worker whose retry must still dedupe.
                     if (worker not in self._applied_seq
-                            and len(self._applied_seq) >= 1024):
-                        self._applied_seq.pop(next(iter(self._applied_seq)))
+                            and len(self._applied_seq) >= self.dedup_cap):
+                        victim = next(iter(self._applied_seq))
+                        self._applied_seq.pop(victim)
+                        # log the first eviction and every 100th after —
+                        # an unthrottled print here runs under the server
+                        # lock once per crash-looping incarnation and
+                        # would serialize all PS traffic on stdout
+                        self._evictions += 1
+                        if self._evictions == 1 or self._evictions % 100 == 0:
+                            print(f"ps/{self.task_index}: dedup table at "
+                                  f"cap {self.dedup_cap}; evicted idle "
+                                  f"incarnation {victim!r} "
+                                  f"({self._evictions} evictions total)")
                 grads = msg["grads"]
                 if msg.get("encoding") == "bf16":
                     grads = {k: _bf16_decode(g) for k, g in grads.items()}
@@ -403,20 +432,24 @@ class PSServer:
     def serve_forever(self):
         """server.join() parity (MNISTDist.py:105-106): block until a
         shutdown message arrives (or the process is killed)."""
-        t = threading.Thread(target=self._server.serve_forever, daemon=True)
-        t.start()
+        self.start_background()
         self._shutdown.wait()
         self._server.shutdown()
 
     def start_background(self) -> threading.Thread:
-        """Testing hook: serve on a daemon thread."""
+        """Serve on a daemon thread."""
+        self._serving = True
         t = threading.Thread(target=self._server.serve_forever, daemon=True)
         t.start()
         return t
 
     def close(self):
         self._shutdown.set()
-        self._server.shutdown()
+        # socketserver.shutdown() waits on an event only serve_forever
+        # sets — calling it on a constructed-but-never-served server
+        # blocks forever, so only shut down an actually-serving loop
+        if getattr(self, "_serving", False):
+            self._server.shutdown()
         self._server.server_close()
 
 
@@ -580,12 +613,14 @@ class PSClient:
             self.call(i, {"op": "ping"})
 
     def init_params(self, flat: dict[str, np.ndarray], assignment: dict[str, int],
-                    optimizer: str = "sgd", learning_rate: float = 0.001):
+                    optimizer: str = "sgd", learning_rate: float = 0.001,
+                    num_workers: int | None = None):
         for i in range(len(self.addresses)):
             shard = {k: v for k, v in flat.items() if assignment[k] == i}
             r = self.call(i, {"op": "init_shard", "params": shard,
                               "optimizer": optimizer,
-                              "learning_rate": learning_rate})
+                              "learning_rate": learning_rate,
+                              "num_workers": num_workers})
             if not r.get("ok"):
                 raise ValueError(f"ps {i} rejected init: {r.get('error')}")
 
@@ -969,13 +1004,15 @@ def run_worker(cluster, FLAGS) -> int:
             blob, _ = restored
             client.init_params(flatten_params(blob["params"]), assignment,
                                optimizer=FLAGS.optimizer,
-                               learning_rate=FLAGS.learning_rate)
+                               learning_rate=FLAGS.learning_rate,
+                               num_workers=cluster.num_tasks("worker"))
             client.call(0, {"op": "set_step", "global_step": int(np.asarray(blob["step"]))})
             print(f"worker/0 restored checkpoint at step {int(np.asarray(blob['step']))}")
         else:
             client.init_params(flat_template, assignment,
                                optimizer=FLAGS.optimizer,
-                               learning_rate=FLAGS.learning_rate)
+                               learning_rate=FLAGS.learning_rate,
+                               num_workers=cluster.num_tasks("worker"))
     else:
         client.wait_initialized()
 

@@ -521,3 +521,75 @@ def test_mirror_cycle_desyncs_on_foreign_push():
         client.close()
         rogue.close()
         server.close()
+
+
+def test_dedup_survives_eviction_pressure_for_active_worker():
+    """An active worker's retry must still dedupe even when the table is
+    at capacity with churning one-shot incarnations (ADVICE r3: the old
+    insertion-order eviction could evict a live-but-slow worker). Both a
+    successful apply AND a dedup hit refresh recency, so churn evicts
+    idle incarnations, never the active worker."""
+    server = PSServer(0, "127.0.0.1:0")
+    try:
+        server.dispatch({"op": "init_shard", "params": {"w": [1.0]},
+                         "optimizer": "sgd", "learning_rate": 0.5,
+                         "num_workers": 3})
+        assert server.dedup_cap == 1024  # floor holds for small clusters
+        server.dedup_cap = 4             # shrink to make churn cheap
+        push = {"op": "push_grads", "grads": {"w": [1.0]},
+                "count_step": True}
+
+        r = server.dispatch(dict(push, worker="slow", seq=0))
+        assert r["ok"] and not r.get("duplicate")
+        # fill the table around it with one-shot incarnations
+        for i in range(3):
+            server.dispatch(dict(push, worker=f"churn{i}", seq=0))
+        # a RETRY (dedup hit) is proof of life: it must refresh recency
+        r = server.dispatch(dict(push, worker="slow", seq=0))
+        assert r["duplicate"]
+        # churn past the cap: every churn incarnation is now older than
+        # the refreshed entry, so they are the eviction victims. (Under
+        # the old insertion-order scheme "slow" was oldest and the very
+        # next new worker would have evicted it.)
+        for i in range(3, 6):
+            server.dispatch(dict(push, worker=f"churn{i}", seq=0))
+        assert "slow" in server._applied_seq
+        assert "churn0" not in server._applied_seq  # idle ones evicted
+        # ...but the active worker's entry survived: retry still no-ops
+        before = server.params["w"].copy()
+        r = server.dispatch(dict(push, worker="slow", seq=0))
+        assert r["duplicate"]
+        np.testing.assert_array_equal(server.params["w"], before)
+    finally:
+        server.close()
+
+
+def test_dedup_cap_scales_with_declared_cluster():
+    """init_shard's num_workers raises the dedup cap to 4x the declared
+    deployment so large clusters can never evict a live worker."""
+    server = PSServer(0, "127.0.0.1:0")
+    try:
+        server.dispatch({"op": "init_shard", "params": {"w": [0.0]},
+                         "optimizer": "sgd", "learning_rate": 0.1,
+                         "num_workers": 1000})
+        assert server.dedup_cap == 4000
+    finally:
+        server.close()
+
+
+def test_negative_seq_for_unknown_worker_is_benign():
+    """A malformed push with seq=-1 for a worker the table has never seen
+    matches the -1 dedup default; the reply must be a duplicate no-op,
+    not a crashed handler (the refresh must not KeyError on a missing
+    entry)."""
+    server = PSServer(0, "127.0.0.1:0")
+    try:
+        server.dispatch({"op": "init_shard", "params": {"w": [1.0]},
+                         "optimizer": "sgd", "learning_rate": 0.5})
+        r = server.dispatch({"op": "push_grads", "grads": {"w": [1.0]},
+                             "worker": "ghost", "seq": -1})
+        assert r["ok"] and r["duplicate"]
+        assert "ghost" not in server._applied_seq
+        np.testing.assert_array_equal(server.params["w"], [1.0])  # no apply
+    finally:
+        server.close()
