@@ -55,6 +55,19 @@ class DataSet:
             self._images_u8 = None
             self._images_f32 = images
         self.labels_int = labels.astype(np.int64)
+        # Fail loudly on out-of-range class ids HERE, at load time: the
+        # TPU-form cross-entropy one-hots integer labels, and
+        # jax.nn.one_hot maps an invalid id to an all-zero row — a
+        # corrupt loader would silently train with those examples
+        # dropped from the loss (ADVICE r3). One O(n) host check at
+        # construction beats a per-step device check.
+        bad = (self.labels_int < 0) | (self.labels_int >= num_classes)
+        if bad.any():
+            idx = int(np.argmax(bad))
+            raise ValueError(
+                f"label out of range: labels[{idx}] = "
+                f"{int(self.labels_int[idx])} not in [0, {num_classes}) "
+                f"({int(bad.sum())} invalid of {len(self.labels_int)})")
         self.one_hot = one_hot
         self.num_classes = num_classes
         self._rng = np.random.default_rng(seed)

@@ -117,8 +117,10 @@ def softmax_cross_entropy(logits, labels):
 
     Integer labels must be in [0, C): out-of-range ids one-hot to an
     all-zero row and contribute zero loss/gradient (jax.nn.one_hot
-    semantics) rather than clamping. The loaders guarantee validity;
-    callers feeding external labels should validate upstream.
+    semantics) rather than clamping. The loaders ENFORCE validity at
+    DataSet construction (datasets.py raises on any id outside
+    [0, num_classes)); callers feeding external labels should validate
+    upstream.
     """
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     if labels.ndim == logits.ndim - 1:  # integer class ids

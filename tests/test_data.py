@@ -127,3 +127,19 @@ def test_synthetic_cifar_shapes():
     x, y = synthetic_cifar(8, seed=0)
     assert x.shape == (8, 32, 32, 3)
     assert x.min() >= 0.0 and x.max() <= 1.0
+
+
+def test_out_of_range_labels_fail_loudly():
+    """A corrupt loader's invalid class id must raise at DataSet
+    construction — the TPU-form CE one-hots integer labels, and an
+    out-of-range id would otherwise silently drop the example from the
+    loss (all-zero one-hot row, ADVICE r3)."""
+    import pytest
+
+    from distributed_tensorflow_tpu.data.datasets import DataSet
+
+    imgs = np.zeros((4, 784), np.float32)
+    with pytest.raises(ValueError, match=r"labels\[2\] = 10"):
+        DataSet(imgs, np.array([0, 1, 10, 3]), num_classes=10)
+    with pytest.raises(ValueError, match="not in"):
+        DataSet(imgs, np.array([0, -1, 2, 3]), num_classes=10)
