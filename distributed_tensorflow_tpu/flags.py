@@ -158,7 +158,11 @@ def define_reference_flags():
                  "The reference defines DROPOUT=0.75 but feeds 1.0 (disabled); "
                  "this build applies it")
     DEFINE_string("logdir", "/tmp/train_logs", "Checkpoint/metrics directory (reference default)")
-    DEFINE_integer("save_model_secs", 600, "Checkpoint cadence in seconds (reference default)")
+    DEFINE_integer("save_model_secs", 600,
+                   "Checkpoint cadence in seconds (reference default). In "
+                   "multi-host runs saves are quantized to --coord_steps "
+                   "boundaries (the cadenced stop/save vote), so a due "
+                   "save can land up to coord_steps steps late")
     DEFINE_integer("max_to_keep", 5, "Checkpoints retained before GC "
                    "(TF Saver's default); older ones are deleted")
     DEFINE_integer("seed", 0, "PRNG seed")

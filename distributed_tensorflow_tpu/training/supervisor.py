@@ -17,6 +17,32 @@ import signal
 from distributed_tensorflow_tpu.checkpoint import Checkpointer
 
 
+class _CancelGate:
+    """Cancel flag whose check and the guarded action are mutually
+    excluded: ``cancel()`` blocks while a holder is inside ``guard()``,
+    so a time-bounded caller that abandons a save either prevents the
+    write entirely or waits for an already-started write to finish
+    before closing the writer — never both racing."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._cancelled = False
+
+    def cancel(self):
+        with self._lock:
+            self._cancelled = True
+
+    @property
+    def lock(self):
+        return self._lock
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+
 class Supervisor:
     def __init__(
         self,
@@ -25,6 +51,8 @@ class Supervisor:
         save_model_secs: int = 600,
         max_to_keep: int = 5,
         background_save: bool = False,
+        final_save_timeout_s: float = 300.0,
+        exit_agreement_timeout_s: float = 60.0,
     ):
         """``background_save`` moves the cadenced checkpoint writes off the
         training thread (the reference Supervisor's Saver ran in background
@@ -32,6 +60,15 @@ class Supervisor:
         always synchronous."""
         self.is_chief = is_chief
         self.logdir = logdir
+        # bounds (pre-grace) on the exit path's two collectives when
+        # state spans hosts; run_bounded extends each 4x with a progress
+        # line before abandoning, so healthy-but-slow runs complete.
+        # Both knobs sit on the same constructor so a slow-rendezvous
+        # deployment tunes them together (an agreement that times out
+        # while the save bound is raised reopens the asymmetric-skip
+        # window).
+        self.final_save_timeout_s = final_save_timeout_s
+        self.exit_agreement_timeout_s = exit_agreement_timeout_s
         self.checkpointer = Checkpointer(
             logdir, is_chief=is_chief, save_model_secs=save_model_secs,
             max_to_keep=max_to_keep, background=background_save,
@@ -111,7 +148,8 @@ class Supervisor:
         the fetch entirely — single-host behavior is unchanged."""
         self._coordinated_save(state, step, final=False)
 
-    def _coordinated_save(self, state, step: int, *, final: bool):
+    def _coordinated_save(self, state, step: int, *, final: bool,
+                          cancelled=None):
         """The ONE implementation of the symmetric fetch-then-chief-writes
         gate, shared by the cadenced vote path and the managed() exit so
         the two cannot drift apart (a gate that differs between them is a
@@ -119,7 +157,14 @@ class Supervisor:
         ``final`` picks the synchronous write over the background-capable
         one. Non-chief processes only join the cross-host collective —
         they never pay the full-model device->host copy the chief needs
-        for the file."""
+        for the file. ``cancelled`` (a ``_CancelGate``) is consulted
+        between the fetch and the write UNDER the gate's lock: a
+        time-bounded caller that abandoned this save either flips the
+        gate first (the late-completing fetch discards) or blocks in
+        ``cancel()`` until an in-flight write finishes (so the
+        checkpointer is never closed mid-write)."""
+        import contextlib as _ctx
+
         from distributed_tensorflow_tpu.utils.pytree import (
             flatten_pytree,
             join_collective_fetch,
@@ -128,10 +173,16 @@ class Supervisor:
 
         if self.is_chief:
             flat = flatten_pytree(state, tag_bf16=True)
-            if final:
-                self.checkpointer.save_fetched(flat, step)
-            else:
-                self.checkpointer.submit_fetched(flat, step)
+            with (cancelled.lock if cancelled is not None
+                  else _ctx.nullcontext()):
+                if cancelled is not None and cancelled.cancelled:
+                    print(f"final checkpoint fetch completed after its "
+                          f"bound expired; discarding (step {step})")
+                    return
+                if final:
+                    self.checkpointer.save_fetched(flat, step)
+                else:
+                    self.checkpointer.submit_fetched(flat, step)
         elif needs_collective_fetch(state):
             join_collective_fetch(state)
 
@@ -200,8 +251,10 @@ class Supervisor:
             clean_exit = True
         finally:
             restore_signals()
+            abandoned = None  # set => raise after cleanup (clean exits)
             if state_box.state is not None:
                 from distributed_tensorflow_tpu.utils.pytree import (
+                    agree_clean_exit,
                     needs_collective_fetch,
                 )
 
@@ -209,24 +262,82 @@ class Supervisor:
                 # the collective fetch (they all exit the loop at the same
                 # agreed step — the stop-vote invariant); only the chief
                 # writes. Locally-fetchable state keeps the chief-only
-                # path. On an EXCEPTION exit the collective is skipped:
-                # peers are not at a matching save (they're still training
-                # or dying themselves), so a one-sided process_allgather
-                # would hang this process forever instead of letting the
-                # job die loudly.
+                # path. Ahead of the collective, ALL processes — clean or
+                # unwinding an exception — join one bounded agreement
+                # allgather of their clean flags: the save proceeds only
+                # when every process is clean, so a mixed exit skips
+                # SYMMETRICALLY instead of stranding clean peers in a
+                # process_allgather the failed process never joins (r3
+                # ADVICE: the unbounded-hang mixed-exit hole).
                 needs = needs_collective_fetch(state_box.state)
-                if needs and not clean_exit:
-                    print("final checkpoint skipped: exiting on an error "
-                          "with cross-host-sharded state (the collective "
-                          "fetch needs every process at the same point)")
-                elif self.is_chief or needs:
-                    try:
-                        self._coordinated_save(state_box.state,
-                                               state_box.step, final=True)
-                    except Exception as e:  # noqa: BLE001 — best-effort
-                        print(f"final checkpoint failed: {e}")
+                proceed = True
+                if needs:
+                    verdict = agree_clean_exit(
+                        clean_exit, timeout_s=self.exit_agreement_timeout_s)
+                    if verdict is None:
+                        proceed = False
+                        abandoned = ("a peer process never reached the "
+                                     "exit agreement (died hard?); final "
+                                     "checkpoint skipped")
+                        print(f"final checkpoint skipped: {abandoned} — "
+                              "dying loudly instead of hanging in the "
+                              "collective fetch")
+                    elif not verdict:
+                        proceed = False
+                        print("final checkpoint skipped: a process exited "
+                              "on an error with cross-host-sharded state "
+                              "(the collective fetch needs every process "
+                              "at the same point; all peers skip "
+                              "symmetrically)")
+                if proceed and (self.is_chief or needs):
+                    if needs:
+                        # the save's collective fetch gets its own bound
+                        # (run_bounded's timeout + grace): even if the
+                        # agreement resolved asymmetrically (a peer
+                        # abandoned it right as it completed — the
+                        # two-generals residue), this process blocks a
+                        # bounded time, then dies loudly instead of
+                        # hanging forever in process_allgather. The
+                        # cancel gate (event + lock, mutually excluded
+                        # with the write) keeps an ABANDONED fetch that
+                        # completes late from writing through the
+                        # checkpointer we are about to close.
+                        from distributed_tensorflow_tpu.utils.pytree import (
+                            run_bounded,
+                        )
+
+                        gate = _CancelGate()
+                        done, err = run_bounded(
+                            lambda: self._coordinated_save(
+                                state_box.state, state_box.step,
+                                final=True, cancelled=gate),
+                            self.final_save_timeout_s,
+                            what="final collective checkpoint")
+                        if not done:
+                            gate.cancel()
+                            abandoned = ("final checkpoint abandoned: a "
+                                         "peer never joined the "
+                                         "collective fetch")
+                            print(f"{abandoned} — exiting loudly")
+                        elif isinstance(err, Exception):
+                            print(f"final checkpoint failed: {err}")
+                    else:
+                        try:
+                            self._coordinated_save(state_box.state,
+                                                   state_box.step,
+                                                   final=True)
+                        except Exception as e:  # noqa: BLE001 best-effort
+                            print(f"final checkpoint failed: {e}")
             self.checkpointer.close()
             self.stop()
+            # an otherwise-clean run whose exit protocol was ABANDONED
+            # (peer died hard) must not report success: raise so the
+            # process exits nonzero and the orchestrator sees the job
+            # failed. When an exception is already unwinding (not
+            # clean_exit), raising here would mask it — the in-flight
+            # error is the loud exit.
+            if abandoned and clean_exit:
+                raise RuntimeError(abandoned)
 
 
 class _StateBox:

@@ -88,6 +88,81 @@ def join_collective_fetch(tree) -> None:
         multihost_utils.process_allgather(span, tiled=True)
 
 
+def run_bounded(fn, timeout_s: float, *, what: str,
+                grace_factor: float = 4.0):
+    """Run ``fn`` on a daemon thread with a LOUD two-stage time bound.
+
+    The pattern both exit-path collectives share (the agreement gather
+    and the final save's fetch): the calling thread blocks in join() and
+    dispatches nothing concurrent (rendezvous-deadlock note in PERF.md),
+    so a peer that never joins cannot hang this process forever. After
+    ``timeout_s`` a progress line is printed and the wait extends by
+    ``grace_factor`` x — a collective completes for ALL processes or
+    none, so a merely-slow link (DCN weather) finishes within the grace
+    and every process proceeds together; only a hard-dead peer exhausts
+    it, on every live process alike.
+
+    Returns ``(done, result)``: ``done`` False means the bound expired
+    and the thread was ABANDONED (still blocked; ``fn`` must tolerate
+    completing late — see the cancel event in supervisor's final save).
+    ``fn`` exceptions are returned, not raised: ``result`` is the
+    exception instance and ``done`` is True."""
+    import threading
+
+    box: dict = {}
+
+    def _run():
+        try:
+            box["result"] = fn()
+        except Exception as e:  # noqa: BLE001 — reported to the caller
+            box["error"] = e
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        print(f"{what} slow (>{timeout_s:.0f}s); waiting up to "
+              f"{grace_factor * timeout_s:.0f}s more before dying loudly "
+              f"(a collective completes for all processes or none)")
+        t.join(grace_factor * timeout_s)
+    if t.is_alive():
+        return False, None
+    if "error" in box:
+        return True, box["error"]
+    return True, box.get("result")
+
+
+def agree_clean_exit(clean: bool, timeout_s: float = 60.0) -> bool | None:
+    """All-process agreement gate ahead of a final COLLECTIVE save.
+
+    Every process — cleanly exiting or unwinding an exception — joins one
+    tiny allgather of its clean flag. Returns True only when EVERY process
+    reported clean (the collective fetch may proceed), False when any peer
+    failed (all processes skip symmetrically), and None when the agreement
+    itself timed out (a peer died hard and will never join; the caller
+    must skip, letting the job die loudly instead of hanging — the r3
+    ADVICE failure mode: clean peers blocked forever in process_allgather
+    while the raising process skipped it).
+
+    Bounded via ``run_bounded`` (two-stage timeout + grace; see its
+    docstring for why the grace closes the asymmetric-abandon window)."""
+
+    def _gather():
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([1.0 if clean else 0.0], np.float32))
+        return bool(np.all(np.asarray(flags) > 0.5))
+
+    done, result = run_bounded(_gather, timeout_s, what="exit agreement")
+    if not done:
+        return None
+    if isinstance(result, Exception):
+        print(f"exit agreement failed: {result}")
+        return None
+    return result
+
+
 def fetch_pytree(tree):
     """Pytree of arrays -> same-structure pytree of host ndarrays, the
     device->host transfers batched into one call.

@@ -177,6 +177,42 @@ def run_train_sp(process_id: int, num_processes: int, port: str,
                     "--model_axis=4"))
 
 
+def run_span_mixed_exit(process_id: int, num_processes: int, port: str,
+                        outdir: str) -> None:
+    """The r3 ADVICE mixed-exit hole: cross-host-sharded state, process 1
+    raises inside managed() while process 0 exits cleanly. Before the
+    exit-agreement gate, p0 entered the final save's process_allgather
+    that p1 (skipping on error) never joined — hanging p0 forever. Now
+    BOTH processes join one bounded agreement allgather of clean flags,
+    see the mixed verdict, and skip the save symmetrically: p0 exits 0
+    with the skip message, p1 exits nonzero with the original error."""
+    jax = _init_cluster(process_id, num_processes, port, local_devices=2)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
+    from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+    mesh = make_mesh(MeshSpec(data=1, model=4))
+    full = np.arange(8.0, dtype=np.float32)
+    w = jax.make_array_from_callback(
+        (8,), NamedSharding(mesh, P("model")), lambda idx: full[idx])
+
+    sup = Supervisor(is_chief=(process_id == 0),
+                     logdir=os.path.join(outdir, "logs"),
+                     save_model_secs=10**6)
+    try:
+        with sup.managed({"w": w, "step": np.int64(0)}) as box:
+            box.update({"w": w, "step": np.int64(3)}, 3)
+            if process_id == 1:
+                raise RuntimeError("injected failure before clean exit")
+    except RuntimeError:
+        print(f"MIXED_EXIT_RAISED p{process_id}", flush=True)
+        jax.distributed.shutdown()
+        sys.exit(7)
+    print(f"MIXED_EXIT_CLEAN p{process_id}", flush=True)
+    jax.distributed.shutdown()
+
+
 def run(process_id: int, num_processes: int, port: str, outdir: str) -> None:
     jax = _init_cluster(process_id, num_processes, port)
 
@@ -234,5 +270,6 @@ if __name__ == "__main__":
           "train_device": run_train_device, "train_tp": run_train_tp,
           "train_tp_span": run_train_tp_span,
           "train_sp": run_train_sp,
+          "span_mixed_exit": run_span_mixed_exit,
           "train_kill": run_train_kill}[mode]
     fn(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5])

@@ -232,3 +232,38 @@ def test_matches_single_process_run(multihost_params):
             got[f"leaf_{j}"], np.asarray(ref), rtol=0.05, atol=5e-3,
             err_msg=f"leaf_{j}",
         )
+
+
+def test_mixed_exit_skips_final_save_without_hanging(tmp_path):
+    """One process raises inside managed() after training while the peer
+    exits cleanly, with cross-host-sharded state (r3 ADVICE): the clean
+    peer must NOT hang in the final save's process_allgather — the
+    exit-agreement gate sees the mixed verdict and both skip
+    symmetrically. A hang here fails via the communicate timeout."""
+    import subprocess as sp
+
+    port = _free_port()
+    script = os.path.join(REPO, "tests", "multihost_worker.py")
+    env = {**os.environ, "PYTHONPATH": REPO}
+    procs = [
+        sp.Popen([sys.executable, script, "span_mixed_exit", str(pid), "2",
+                  str(port), str(tmp_path)],
+                 env=env, cwd=REPO, stdout=sp.PIPE, stderr=sp.STDOUT,
+                 text=True)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    assert procs[0].returncode == 0, outs[0][-2000:]
+    assert procs[1].returncode == 7, outs[1][-2000:]
+    assert "MIXED_EXIT_CLEAN p0" in outs[0]
+    assert "final checkpoint skipped" in outs[0], outs[0][-2000:]
+    assert "MIXED_EXIT_RAISED p1" in outs[1]
