@@ -34,6 +34,8 @@ _MNIST_FILES = {
 
 SYNTHETIC_TRAIN = 20000
 SYNTHETIC_TEST = 2000
+LM_TRAIN = 4096  # lm split sizes: sequences are procedural, fresh-
+LM_TEST = 512    # permutation-per-row; memorization is impossible anyway
 
 
 class DataSet:
@@ -245,12 +247,36 @@ def read_data_sets(
     dataset: str = "mnist",
     seed: int = 0,
     validation_size: int = 0,
+    seq_len: int = 256,
+    vocab_size: int = 64,
 ) -> Datasets:
     """API parity with the tutorial loader the reference imports
     (``MNISTDist.py:11,167``), extended with ``dataset`` selection:
-    "mnist" | "fashion_mnist" (same IDX format) | "cifar10".
+    "mnist" | "fashion_mnist" (same IDX format) | "cifar10" | "lm"
+    (procedural associative-recall token sequences for the causal-LM
+    family; ``seq_len``/``vocab_size`` apply only there).
     Falls back to procedural data when files are absent (offline envs)."""
     dataset = dataset.lower().replace("-", "_")
+    if dataset == "lm":
+        from distributed_tensorflow_tpu.data.lm import LMDataSet
+
+        train = LMDataSet(LM_TRAIN, seq_len, vocab_size, seed=seed)
+        test = LMDataSet(LM_TEST, seq_len, vocab_size, seed=seed + 10_000)
+        val = None
+        if validation_size:
+            # generated independently (own seed space), not carved from a
+            # finite split — any positive size works
+            if validation_size < 0:
+                raise ValueError(
+                    f"validation_size={validation_size} must be >= 0")
+            val = LMDataSet(validation_size, seq_len, vocab_size,
+                            seed=seed + 20_000)
+        return Datasets(
+            train=train, test=test, validation=val, source="synthetic",
+            meta={"kind": "lm", "seq_len": seq_len,
+                  "vocab_size": vocab_size,
+                  "num_classes": vocab_size},
+        )
     if dataset in ("mnist", "fashion_mnist"):
         raw = _load_mnist_idx(data_dir) if data_dir and os.path.isdir(data_dir) else None
         if raw is not None:

@@ -146,9 +146,13 @@ def define_reference_flags():
                   "'ps' roles when --ps_hosts is set (reference semantics), "
                   "else sync DP over all local devices")
     DEFINE_string("model", "deep_cnn", "Model architecture: "
-                  "deep_cnn|mlp|resnet20|resnet32 (mlp reads "
-                  "--hidden_units; the other models don't)")
-    DEFINE_string("dataset", "mnist", "Dataset: mnist|fashion_mnist|cifar10")
+                  "deep_cnn|mlp|resnet20|resnet32|transformer|lm (mlp "
+                  "reads --hidden_units; lm is the causal next-token "
+                  "family and requires --dataset lm)")
+    DEFINE_string("dataset", "mnist", "Dataset: mnist|fashion_mnist|"
+                  "cifar10|lm (lm: procedural associative-recall token "
+                  "sequences for the causal-LM family; --seq_len/"
+                  "--vocab_size shape it)")
     DEFINE_string("optimizer", "sgd", "Optimizer: sgd|momentum|adam (reference: sgd)")
     DEFINE_float("weight_decay", 0.0, "Decoupled weight decay: the update "
                  "subtracts lr*wd*param alongside the gradient step "
@@ -239,6 +243,23 @@ def define_reference_flags():
                    "update. local/sync/TP modes; incompatible with "
                    "--device_data (whose batches are already sampled "
                    "on device per step)")
+    DEFINE_integer("seq_len", 256, "Context length for --dataset lm "
+                   "(tokens per training sequence; targets are the "
+                   "sequence shifted one token)")
+    DEFINE_integer("vocab_size", 64, "Vocabulary for --dataset lm")
+    DEFINE_integer("d_model", 128, "Transformer width (transformer|lm)")
+    DEFINE_integer("num_heads", 4, "Attention heads (transformer|lm)")
+    DEFINE_integer("num_blocks", 2, "Transformer blocks (transformer|lm)")
+    DEFINE_integer("attn_block", 0, "If > 0, single-device attention "
+                   "streams over key/value blocks of this many tokens "
+                   "(online softmax — O(S*block) peak memory instead of "
+                   "the dense O(S^2) score matrix; the one-chip "
+                   "long-context path). lm model only; mutually "
+                   "exclusive with --seq_parallel's ring attention")
+    DEFINE_boolean("remat", False, "Rematerialize each transformer block "
+                   "in the backward pass (jax.checkpoint): activation "
+                   "memory drops to one block's worth at the cost of "
+                   "one extra forward — the standard long-context trade")
     DEFINE_string("prng", "threefry", "PRNG implementation: threefry "
                   "(default, partition-invariant) or rbg (hardware RNG — "
                   "measured ~4% faster steps on TPU; dropout masks and "

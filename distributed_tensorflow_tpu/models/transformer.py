@@ -31,6 +31,7 @@ from distributed_tensorflow_tpu.models.cnn import truncated_normal_init
 from distributed_tensorflow_tpu.models.registry import register_model
 from distributed_tensorflow_tpu.ops import nn
 from distributed_tensorflow_tpu.ops.attention import (
+    blockwise_attention,
     multi_head_attention,
     ring_attention,
 )
@@ -42,6 +43,39 @@ def _layernorm(x, gain, bias, eps=1e-5):
     var = xf.var(axis=-1, keepdims=True)
     y = (xf - mu) * jax.lax.rsqrt(var + eps)
     return (y * gain + bias).astype(x.dtype)
+
+
+def _block_params(w, d, h, dh, mlp_dim, dtype):
+    """One pre-LN block's parameter dict (shared by both transformer
+    families so their checkpoints stay structurally interchangeable)."""
+    return {
+        "ln1_g": jnp.ones((d,), dtype),
+        "ln1_b": jnp.zeros((d,), dtype),
+        "qkv": w((d, 3, h, dh)),
+        "proj": w((h * dh, d)),
+        "ln2_g": jnp.ones((d,), dtype),
+        "ln2_b": jnp.zeros((d,), dtype),
+        "mlp_in": {"w": w((d, mlp_dim)), "b": jnp.zeros((mlp_dim,), dtype)},
+        "mlp_out": {"w": w((mlp_dim, d)), "b": jnp.zeros((d,), dtype)},
+    }
+
+
+def _transformer_block(h, blk, attn_fn, cd):
+    """One pre-LN transformer block: LN -> attention -> residual ->
+    LN -> MLP -> residual. ``attn_fn(q, k, v)`` supplies the attention
+    flavor (dense / blockwise / ring, causal or not) so the block is the
+    ONE implementation both model families and every parallelism mode
+    run."""
+    y = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
+    qkv = jnp.einsum("bsd,dthe->tbshe", y, blk["qkv"].astype(y.dtype))
+    a = attn_fn(qkv[0], qkv[1], qkv[2])
+    a = a.reshape(*a.shape[:2], -1)  # (B, S, H*Dh)
+    h = h + nn.dense(a, blk["proj"], compute_dtype=cd)
+    y = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
+    y = jax.nn.relu(nn.dense(y, blk["mlp_in"]["w"], blk["mlp_in"]["b"],
+                             compute_dtype=cd))
+    return h + nn.dense(y, blk["mlp_out"]["w"], blk["mlp_out"]["b"],
+                        compute_dtype=cd)
 
 
 @register_model("transformer")
@@ -68,10 +102,12 @@ class MiniTransformer:
         mlp_ratio: int = 4,
         compute_dtype: Any = None,
         seq_axis: str | None = None,
+        remat: bool = False,
         **_unused,  # registry passes hidden_units etc. to every model
     ):
         if d_model % num_heads:
             raise ValueError(f"d_model={d_model} % num_heads={num_heads} != 0")
+        self.remat = remat
         self.image_size = image_size
         self.channels = channels
         self.num_classes = num_classes
@@ -103,16 +139,8 @@ class MiniTransformer:
             },
         }
         for _ in range(self.num_blocks):
-            params["blocks"].append({
-                "ln1_g": jnp.ones((d,), dtype),
-                "ln1_b": jnp.zeros((d,), dtype),
-                "qkv": w((d, 3, h, dh)),
-                "proj": w((h * dh, d)),
-                "ln2_g": jnp.ones((d,), dtype),
-                "ln2_b": jnp.zeros((d,), dtype),
-                "mlp_in": {"w": w((d, self.mlp_dim)), "b": jnp.zeros((self.mlp_dim,), dtype)},
-                "mlp_out": {"w": w((self.mlp_dim, d)), "b": jnp.zeros((d,), dtype)},
-            })
+            params["blocks"].append(
+                _block_params(w, d, h, dh, self.mlp_dim, dtype))
         return params
 
     # ---- forward -------------------------------------------------------
@@ -138,22 +166,16 @@ class MiniTransformer:
             pos = lax.dynamic_slice_in_dim(pos, start, s_local, axis=0)
         h = h + pos.astype(h.dtype)
 
+        if self.seq_axis is not None:
+            attn = lambda q, k, v: ring_attention(q, k, v, self.seq_axis)
+        else:
+            attn = multi_head_attention
+        blk_fn = _transformer_block
+        if self.remat:
+            blk_fn = jax.checkpoint(_transformer_block,
+                                    static_argnums=(2, 3))
         for blk in params["blocks"]:
-            y = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
-            qkv = jnp.einsum("bsd,dthe->tbshe",
-                             y, blk["qkv"].astype(y.dtype))
-            q, k, v = qkv[0], qkv[1], qkv[2]
-            if self.seq_axis is not None:
-                a = ring_attention(q, k, v, self.seq_axis)
-            else:
-                a = multi_head_attention(q, k, v)
-            a = a.reshape(*a.shape[:2], -1)  # (B, S, H*Dh)
-            h = h + nn.dense(a, blk["proj"], compute_dtype=cd)
-            y = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
-            y = jax.nn.relu(nn.dense(y, blk["mlp_in"]["w"],
-                                     blk["mlp_in"]["b"], compute_dtype=cd))
-            h = h + nn.dense(y, blk["mlp_out"]["w"], blk["mlp_out"]["b"],
-                             compute_dtype=cd)
+            h = blk_fn(h, blk, attn, cd)
 
         h = _layernorm(h, params["ln_f"]["g"], params["ln_f"]["b"])
         # mean-pool over the FULL sequence: local sum, psum across the
@@ -164,6 +186,136 @@ class MiniTransformer:
         pooled = pooled / jnp.asarray(self.seq_len, pooled.dtype)
         pooled = nn.dropout(pooled, keep_prob, rng, deterministic=not train)
         logits = nn.dense(pooled, params["head"]["w"], params["head"]["b"],
+                          compute_dtype=cd)
+        return logits.astype(jnp.float32)
+
+    def num_params(self, params=None):
+        if params is None:
+            params = jax.eval_shape(lambda: self.init(jax.random.key(0)))
+        return sum(int(jnp.size(p)) for p in jax.tree.leaves(params))
+
+
+@register_model("lm")
+class TransformerLM:
+    """Causal (next-token) transformer language model — the long-context
+    flagship. The reference framework is images-only (MNISTDist.py:68);
+    this is the build's beyond-parity extension, and the end-to-end
+    consumer of the causal attention forms.
+
+    Input: integer token ids (B, S); output: per-token logits (B, S, V).
+    The per-token cross-entropy and accuracy come from the SAME loss ops
+    the classifiers use — ``ops.nn.softmax_cross_entropy`` and
+    ``accuracy`` already handle labels.ndim == logits.ndim - 1, so the
+    whole train-state/step/loop stack runs unchanged on (B, S) integer
+    targets.
+
+    Attention flavors (all causal):
+    - ``seq_axis=None, attn_block=None``: dense triangle — fine to a few
+      thousand tokens, O(S^2) memory.
+    - ``attn_block=N``: single-device blockwise streaming softmax —
+      O(S*N) peak memory, the one-chip long-context path.
+    - ``seq_axis="model"``: RING attention over the mesh axis; tokens
+      sharded, k/v blocks rotating on ICI — the multi-chip long-context
+      path (must run inside the SP shard_map step).
+    ``remat=True`` wraps each block in ``jax.checkpoint`` — activation
+    memory drops from O(num_blocks * S * d) to O(S * d) + one block's
+    recompute, the standard trade for long sequences.
+    """
+
+    stateful = False
+
+    def __init__(
+        self,
+        vocab_size: int = 64,
+        seq_len: int = 256,
+        d_model: int = 128,
+        num_heads: int = 4,
+        num_blocks: int = 2,
+        mlp_ratio: int = 4,
+        compute_dtype: Any = None,
+        seq_axis: str | None = None,
+        attn_block: int | None = None,
+        remat: bool = False,
+        **_unused,
+    ):
+        if d_model % num_heads:
+            raise ValueError(f"d_model={d_model} % num_heads={num_heads} != 0")
+        if seq_axis is not None and attn_block is not None:
+            raise ValueError("seq_axis (ring) and attn_block (local "
+                             "blockwise) are mutually exclusive attention "
+                             "flavors")
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.d_model = d_model
+        self.num_heads = num_heads
+        self.num_blocks = num_blocks
+        self.mlp_dim = mlp_ratio * d_model
+        self.compute_dtype = compute_dtype
+        self.seq_axis = seq_axis
+        self.attn_block = attn_block
+        self.remat = remat
+
+    def init(self, key, dtype=jnp.float32):
+        d, h = self.d_model, self.num_heads
+        dh = d // h
+        keys = iter(jax.random.split(key, 4 + 7 * self.num_blocks))
+
+        def w(shape, stddev=0.02):
+            return truncated_normal_init(next(keys), shape, stddev, dtype)
+
+        params = {
+            "tok": w((self.vocab_size, d)),
+            "pos": w((self.seq_len, d)),
+            "blocks": [],
+            "ln_f": {"g": jnp.ones((d,), dtype), "b": jnp.zeros((d,), dtype)},
+            "head": {
+                "w": w((d, self.vocab_size)),
+                "b": jnp.zeros((self.vocab_size,), dtype),
+            },
+        }
+        for _ in range(self.num_blocks):
+            params["blocks"].append(
+                _block_params(w, d, h, dh, self.mlp_dim, dtype))
+        return params
+
+    def apply(self, params, x, *, keep_prob=1.0, rng=None, train: bool = False):
+        cd = self.compute_dtype
+        # x: integer ids (B, S) — or the LOCAL token block (B, S/P) when
+        # called inside the SP shard_map step
+        h = jnp.take(params["tok"], x, axis=0)
+        pos = params["pos"]
+        if self.seq_axis is not None:
+            s_local = x.shape[1]
+            start = lax.axis_index(self.seq_axis) * s_local
+            pos = lax.dynamic_slice_in_dim(pos, start, s_local, axis=0)
+        h = h + pos.astype(h.dtype)
+        if cd is not None:
+            h = h.astype(cd)
+
+        if self.seq_axis is not None:
+            attn = lambda q, k, v: ring_attention(
+                q, k, v, self.seq_axis, causal=True)
+        elif self.attn_block is not None:
+            attn = lambda q, k, v: blockwise_attention(
+                q, k, v, self.attn_block, causal=True)
+        else:
+            attn = lambda q, k, v: multi_head_attention(q, k, v, causal=True)
+
+        blk_fn = _transformer_block
+        if self.remat:
+            blk_fn = jax.checkpoint(_transformer_block,
+                                    static_argnums=(2, 3))
+        for blk in params["blocks"]:
+            h = blk_fn(h, blk, attn, cd)
+
+        h = _layernorm(h, params["ln_f"]["g"], params["ln_f"]["b"])
+        if rng is not None and self.seq_axis is not None:
+            # per-token dropout: decorrelate the mask across sequence
+            # shards (each shard holds DIFFERENT tokens — unlike the
+            # classifier's post-pool dropout, which must be identical)
+            rng = jax.random.fold_in(rng, lax.axis_index(self.seq_axis))
+        h = nn.dropout(h, keep_prob, rng, deterministic=not train)
+        logits = nn.dense(h, params["head"]["w"], params["head"]["b"],
                           compute_dtype=cd)
         return logits.astype(jnp.float32)
 

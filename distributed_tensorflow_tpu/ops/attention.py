@@ -42,6 +42,78 @@ def multi_head_attention(q, k, v, causal: bool = False):
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 
 
+def _online_softmax_step(qf, scale, o, m, l, k_blk, v_blk, mask):
+    """Fold one k/v block into the streaming-softmax accumulators.
+
+    The one implementation of the flash/online-softmax recurrence, shared
+    by ``ring_attention`` (blocks arrive over ICI) and
+    ``blockwise_attention`` (blocks are scanned locally): running max m,
+    denominator l, unnormalized numerator o, all f32. ``mask`` (broadcast
+    to (B, H, Sq, Skb)) or None."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+    s = s * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l = l * corr + p.sum(axis=-1)
+    o = o * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+    return o, m_new, l
+
+
+def blockwise_attention(q, k, v, block_size: int, causal: bool = False):
+    """Single-device attention with O(S * block) peak memory.
+
+    Same math as ``multi_head_attention`` (pinned by tests), computed as
+    a ``lax.scan`` over k/v blocks with the online-softmax recurrence —
+    the full (Sq, Sk) score matrix never materializes, so a long context
+    fits one chip's HBM where the dense form would not (peak activation
+    is one (B, H, Sq, block) panel instead of (B, H, Sq, Sk)). This is
+    the dense/ single-chip half of the long-context story;
+    ``ring_attention`` is the same recurrence with blocks arriving over
+    the mesh instead of a local scan.
+
+    ``causal=True`` masks by absolute position, identical to the dense
+    triangle. Blocks entirely above the diagonal still run (static scan
+    length — XLA needs static shapes) but contribute exact zeros; queries
+    attend their own block first via the mask, not by reordering, so the
+    recurrence stays the plain scan.
+    """
+    b, sq, h, dh = q.shape
+    sk = k.shape[1]
+    if sk % block_size:
+        raise ValueError(f"key length {sk} must divide into blocks of "
+                         f"{block_size}")
+    n_blocks = sk // block_size
+    scale = 1.0 / jnp.sqrt(jnp.float32(dh))
+    qf = q.astype(jnp.float32)
+    rows = jnp.arange(sq)
+    # scan over key/value blocks: (n_blocks, B, blk, H, Dh)
+    kb = jnp.moveaxis(k.reshape(b, n_blocks, block_size, h, dh), 1, 0)
+    vb = jnp.moveaxis(v.reshape(b, n_blocks, block_size, h, dh), 1, 0)
+
+    def step(carry, inp):
+        o, m, l = carry
+        t, k_blk, v_blk = inp
+        mask = None
+        if causal:
+            cols = t * block_size + jnp.arange(block_size)
+            mask = (cols[None, :] <= rows[:, None])[None, None]
+        o, m, l = _online_softmax_step(qf, scale, o, m, l, k_blk, v_blk,
+                                       mask)
+        return (o, m, l), None
+
+    o0 = jnp.zeros((b, h, sq, dh), jnp.float32)
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (o, _, l), _ = lax.scan(step, (o0, m0, l0),
+                            (jnp.arange(n_blocks), kb, vb))
+    out = o / l[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     """Ring attention over the mesh axis ``axis_name`` (sequence-sharded).
 
@@ -74,19 +146,11 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     row_global = me * sq + jnp.arange(sq)  # my queries' global positions
 
     def attend(o, m, l, k_blk, v_blk, owner):
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
-        s = s * scale  # (B, H, Sq, Skb)
+        mask = None
         if causal:
             col_global = owner * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
-            mask = col_global[None, :] <= row_global[:, None]
-            s = jnp.where(mask[None, None], s, -jnp.inf)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
-        return o, m_new, l
+            mask = (col_global[None, :] <= row_global[:, None])[None, None]
+        return _online_softmax_step(qf, scale, o, m, l, k_blk, v_blk, mask)
 
     def ring_step(carry, t):
         # rotate FIRST, then attend: the locally-held block is consumed

@@ -976,7 +976,9 @@ def run_worker(cluster, FLAGS) -> int:
     if err is not None:
         raise ValueError(err)
     ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
-                        seed=FLAGS.seed + FLAGS.task_index)
+                        seed=FLAGS.seed + FLAGS.task_index,
+                        seq_len=getattr(FLAGS, "seq_len", 256),
+                        vocab_size=getattr(FLAGS, "vocab_size", 64))
     model = build_model_for(FLAGS, ds.meta)
     is_chief = FLAGS.task_index == 0
     wire = getattr(FLAGS, "ps_wire", "f32")

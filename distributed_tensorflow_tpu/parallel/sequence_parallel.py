@@ -12,16 +12,43 @@ sequence. Peak per-device activation memory is one token block
 regardless of total sequence length — the property that makes long
 contexts fit at all.
 
-Gradient reduction is the subtle half: each sequence shard
+Gradient reduction is the subtle half, and the two loss families need
+separate derivations (both land on the SAME uniform pmean, for
+different reasons):
+
+POOLED CLASSIFIER (MiniTransformer): each sequence shard
 differentiates its own replicated copy of the loss and the pooled
 psum's transpose is itself a psum, so per-token parameter gradients
 arrive as their true partials scaled by the axis size P, while the
 post-pool head's gradients arrive bitwise-replicated — ONE uniform
 pmean over the sequence axis reduces both exactly (mean of P-scaled
-partials = the total; mean of replicas = identity). Then pmean over
-"data" as in ordinary sync DP, and every device applies the identical
-update so the replicated state stays in sync. Exactness vs the dense
-single-device step is pinned by tests/test_attention.py.
+partials = the total; mean of replicas = identity).
+
+PER-TOKEN LOSS (TransformerLM): nothing is replicated — shard p's
+local loss L_p is the mean over ITS OWN (B_local, S/P) tokens, a
+different scalar on every shard, and the global loss is
+L = (1/P) * sum_p L_p (equal shard sizes make the mean of means the
+token mean). Inside shard_map each shard seeds reverse-mode with
+cotangent 1.0 on its OWN L_p; the joint transposed program therefore
+computes the gradient of sum_p L_p = P*L. Cross-shard paths are
+handled by the collectives' transposes — a query on shard q attends
+keys shard p produced, and the ppermute transpose (the reverse
+rotation) carries that cotangent back to shard p's backward — so the
+per-shard grad outputs g_p are EXACT partitions of the total:
+sum_p g_p = d(P*L)/dtheta. The uniform pmean (1/P)*sum_p g_p is then
+exactly dL/dtheta. Note what changed from the pooled case: there the
+factor P came from the psum transpose P-scaling every pre-pool
+cotangent; here it comes from P independent loss seeds. Same
+reduction, different proof — and the METRICS differ too: pooled
+metrics are replicated over the sequence axis (pmean = identity),
+per-token metrics are shard-local means that MUST be pmean'd over the
+sequence axis to report the global mean (the step does both
+unconditionally, exact in either case).
+
+Then pmean over "data" as in ordinary sync DP, and every device
+applies the identical update so the replicated state stays in sync.
+Exactness vs the dense single-device step is pinned by
+tests/test_attention.py (pooled) and tests/test_lm.py (per-token).
 """
 
 from __future__ import annotations
@@ -40,9 +67,12 @@ from distributed_tensorflow_tpu.training.train_state import (
 )
 
 
-def stage_batch_sp(mesh, batch):
-    """(x, y) host batch -> device arrays with x (B, S, token) tiled
-    (batch over "data", tokens over "model") and labels batch-sharded.
+def stage_batch_sp(mesh, batch, per_token_targets: bool = False):
+    """(x, y) host batch -> device arrays with x tiled batch-over-"data",
+    tokens-over-"model". Targets: batch-sharded for the pooled
+    classifier (one label per example), or tiled EXACTLY like x when
+    ``per_token_targets`` (the LM's (B, S) next-token targets live on
+    the same shard as the tokens whose logits they score).
 
     Multi-process: ``batch`` is this process's LOCAL slice of the global
     batch with the FULL token axis (the "model"/sequence axis must stay
@@ -52,9 +82,11 @@ def stage_batch_sp(mesh, batch):
     from distributed_tensorflow_tpu.parallel.mesh import put_global
 
     x, y = batch
+    y_spec = (P(DATA_AXIS, MODEL_AXIS) if per_token_targets
+              else P(DATA_AXIS))
     return put_global(
         (NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS)),
-         NamedSharding(mesh, P(DATA_AXIS))),
+         NamedSharding(mesh, y_spec)),
         (x, y),
     )
 
@@ -70,13 +102,15 @@ def reshape_for_sp(model, x):
 
 
 def make_sp_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
-                       donate: bool = True):
+                       donate: bool = True,
+                       per_token_targets: bool = False):
     """Compiled sequence-parallel train step: (state, staged batch) ->
     (state, metrics).
 
     ``model`` must be constructed with ``seq_axis=MODEL_AXIS`` (it then
-    ring-attends and psum-pools over that axis). State (params + opt
-    slots) replicates.
+    ring-attends over that axis). State (params + opt slots) replicates.
+    ``per_token_targets`` matches ``stage_batch_sp``'s: the LM's (B, S)
+    targets are sharded over the token axis like the inputs.
     """
     if getattr(model, "seq_axis", None) != MODEL_AXIS:
         raise ValueError(
@@ -85,9 +119,11 @@ def make_sp_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
 
     def per_shard(state: TrainState, batch):
         rng, sub = jax.random.split(state.rng)
-        # dropout runs on the REPLICATED post-pool path: the mask must be
-        # identical across sequence shards (distinct only per data shard)
-        # or the replicated head computation diverges between shards
+        # dropout key: distinct per data shard. Across SEQUENCE shards
+        # the key stays identical — the pooled classifier's post-pool
+        # dropout REQUIRES that (the replicated head computation must
+        # not diverge between shards); the LM folds the sequence index
+        # in itself (its per-token dropout wants decorrelated masks).
         sub = jax.random.fold_in(sub, lax.axis_index(DATA_AXIS))
 
         grads, shard_metrics, model_state = compute_grads(
@@ -95,29 +131,32 @@ def make_sp_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
             model_state=state.model_state,
         )
         # ONE uniform pmean over the sequence axis is exact for EVERY
-        # parameter: per-token params (embeddings, block weights) carry
-        # their true partial contribution scaled by P — each of the P
-        # sequence shards differentiates its own replicated copy of the
-        # loss, and the pooled psum's transpose is itself a psum,
-        # multiplying every pre-pool cotangent by P — so
-        # pmean = (1/P) * sum(P * partial_i) = the exact total. Post-pool
-        # (head) params see the replicated pooled vector and identical
-        # labels/dropout, so their grads are already bitwise-replicated
-        # across sequence shards and pmean is the identity.
-        # tests/test_attention.py pins the trajectory equivalence.
+        # parameter and BOTH loss families — see the module docstring's
+        # two derivations (pooled: psum-transpose P-scaling + replicated
+        # head; per-token: P independent loss seeds whose per-shard
+        # grads partition d(P*L)/dtheta, with ppermute transposes
+        # carrying cross-shard cotangents home).
+        # tests/test_attention.py and tests/test_lm.py pin both.
         grads = lax.pmean(grads, MODEL_AXIS)
         grads = lax.pmean(grads, DATA_AXIS)
-        metrics = lax.pmean(shard_metrics, DATA_AXIS)
+        # metrics: pooled-classifier metrics are replicated over the
+        # sequence axis (pmean = identity); per-token metrics are
+        # shard-local token means that NEED the sequence pmean to be
+        # the global token mean. Unconditional, exact for both.
+        metrics = lax.pmean(shard_metrics, MODEL_AXIS)
+        metrics = lax.pmean(metrics, DATA_AXIS)
         updates, opt_state = optimizer.update(grads, state.opt_state,
                                               state.params, state.step)
         params = apply_updates(state.params, updates)
         return (TrainState(params, opt_state, state.step + 1, rng,
                            model_state), metrics)
 
+    y_spec = (P(DATA_AXIS, MODEL_AXIS) if per_token_targets
+              else P(DATA_AXIS))
     sharded = jax.shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(P(), (P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS))),
+        in_specs=(P(), (P(DATA_AXIS, MODEL_AXIS), y_spec)),
         out_specs=(P(), P()),
         check_vma=False,  # rng ops + replicated-out pattern
     )
@@ -126,20 +165,26 @@ def make_sp_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
     return jax.jit(sharded)
 
 
-def make_sp_eval_step(model, mesh):
-    """Dropout-off metrics over the SP layout, pmean'd over "data".
+def make_sp_eval_step(model, mesh, per_token_targets: bool = False):
+    """Dropout-off metrics over the SP layout, pmean'd over both axes
+    (sequence pmean is the identity for pooled metrics and the global
+    token mean for per-token metrics — same argument as the train
+    step's).
 
     Accepts (and ignores) a trailing ``model_state`` so the training
     loop can call every mode's eval step with one signature (the
     transformer is stateless)."""
     def per_shard(params, batch):
         _, aux = loss_and_metrics(model, params, batch, train=False)
-        return lax.pmean(aux["metrics"], DATA_AXIS)
+        m = lax.pmean(aux["metrics"], MODEL_AXIS)
+        return lax.pmean(m, DATA_AXIS)
 
+    y_spec = (P(DATA_AXIS, MODEL_AXIS) if per_token_targets
+              else P(DATA_AXIS))
     sharded = jax.jit(jax.shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(P(), (P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS))),
+        in_specs=(P(), (P(DATA_AXIS, MODEL_AXIS), y_spec)),
         out_specs=P(),
         check_vma=False,
     ))

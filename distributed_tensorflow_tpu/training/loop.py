@@ -68,6 +68,28 @@ def build_model_for(FLAGS, meta: dict):
     import jax.numpy as jnp
 
     compute_dtype = jnp.bfloat16 if FLAGS.bf16 else None
+    if meta.get("kind") == "lm":
+        # token data feeds only the causal-LM family (a pixel classifier
+        # cannot consume ids), and vice versa — pair them loudly
+        if FLAGS.model != "lm":
+            raise ValueError(
+                f"--dataset lm produces token sequences; --model "
+                f"{FLAGS.model!r} is an image model. Use --model lm.")
+        attn_block = int(getattr(FLAGS, "attn_block", 0))
+        return get_model(
+            "lm",
+            vocab_size=meta["vocab_size"],
+            seq_len=meta["seq_len"],
+            d_model=FLAGS.d_model,
+            num_heads=FLAGS.num_heads,
+            num_blocks=FLAGS.num_blocks,
+            compute_dtype=compute_dtype,
+            attn_block=attn_block if attn_block > 0 else None,
+            remat=bool(getattr(FLAGS, "remat", False)),
+        )
+    if FLAGS.model == "lm":
+        raise ValueError("--model lm consumes token sequences; use "
+                         "--dataset lm")
     kwargs = {}
     if FLAGS.model == "deep_cnn" and getattr(FLAGS, "pallas", False):
         kwargs["use_pallas"] = True
@@ -76,6 +98,10 @@ def build_model_for(FLAGS, meta: dict):
         # live (models/mlp.py); deep_cnn keeps the reference's fixed 1024
         # FC width (MNISTDist.py:83 — the flag was dead there too)
         kwargs["hidden_units"] = FLAGS.hidden_units
+    if FLAGS.model == "transformer":
+        kwargs.update(d_model=FLAGS.d_model, num_heads=FLAGS.num_heads,
+                      num_blocks=FLAGS.num_blocks,
+                      remat=bool(getattr(FLAGS, "remat", False)))
     return get_model(
         FLAGS.model,
         image_size=meta["image_size"],
@@ -100,8 +126,11 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     n_procs = jax.process_count()
     data_seed = FLAGS.seed + (jax.process_index() if n_procs > 1 else 0)
     ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
-                        seed=data_seed, validation_size=FLAGS.validation_size)
+                        seed=data_seed, validation_size=FLAGS.validation_size,
+                        seq_len=getattr(FLAGS, "seq_len", 256),
+                        vocab_size=getattr(FLAGS, "vocab_size", 64))
     model = build_model_for(FLAGS, ds.meta)
+    is_lm = ds.meta.get("kind") == "lm"
     opt = get_optimizer(FLAGS.optimizer, schedule_from_flags(FLAGS),
                         weight_decay=getattr(FLAGS, "weight_decay", 0.0))
     state = create_train_state(model, opt, seed=FLAGS.seed)
@@ -125,6 +154,10 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         clip = clip_by_global_norm(FLAGS.clip_norm)
     augment = None
     if getattr(FLAGS, "augment", False):
+        if is_lm:
+            raise ValueError("--augment crops/flips images; token "
+                             "sequences (--dataset lm) have no image "
+                             "layout to augment")
         from distributed_tensorflow_tpu.ops.augment import make_augment
 
         # flip only natural images (CIFAR): mirroring digits corrupts the
@@ -156,6 +189,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         # SP model cannot apply outside shard_map (lax.axis_index).
         from distributed_tensorflow_tpu.models.transformer import (
             MiniTransformer,
+            TransformerLM,
         )
         from distributed_tensorflow_tpu.parallel import MeshSpec
         from distributed_tensorflow_tpu.parallel.mesh import (
@@ -169,9 +203,9 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             stage_batch_sp,
         )
 
-        if not isinstance(model, MiniTransformer):
+        if not isinstance(model, (MiniTransformer, TransformerLM)):
             raise ValueError(
-                f"--seq_parallel requires --model transformer (an "
+                f"--seq_parallel requires --model transformer or lm (an "
                 f"attention model with a token axis to shard); got "
                 f"--model {FLAGS.model!r}")
         if mode != "sync":
@@ -186,6 +220,11 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             raise ValueError(
                 f"sequence length {model.seq_len} must divide into "
                 f"--model_axis={model_axis} token blocks")
+        if int(getattr(FLAGS, "attn_block", 0)) > 0:
+            raise ValueError(
+                "--attn_block (local blockwise attention) and "
+                "--seq_parallel (ring attention) are mutually exclusive "
+                "attention flavors — the SP step ring-attends; drop one")
         for flag, why in (
             ("device_data", "the device-resident sampler has no token "
                             "sharding"),
@@ -201,12 +240,25 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             raise ValueError("--clip_norm is not supported with "
                              "--seq_parallel")
 
-        sp_model = MiniTransformer(
-            image_size=model.image_size, channels=model.channels,
-            num_classes=model.num_classes, d_model=model.d_model,
-            num_heads=model.num_heads, num_blocks=model.num_blocks,
-            mlp_ratio=model.mlp_dim // model.d_model,
-            compute_dtype=model.compute_dtype, seq_axis=MODEL_AXIS)
+        if is_lm:
+            # the SP twin ring-attends causally; identical params/math
+            # to the dense model built above (blockwise/dense forms are
+            # its host-side evaluators)
+            sp_model = TransformerLM(
+                vocab_size=model.vocab_size, seq_len=model.seq_len,
+                d_model=model.d_model, num_heads=model.num_heads,
+                num_blocks=model.num_blocks,
+                mlp_ratio=model.mlp_dim // model.d_model,
+                compute_dtype=model.compute_dtype, seq_axis=MODEL_AXIS,
+                remat=model.remat)
+        else:
+            sp_model = MiniTransformer(
+                image_size=model.image_size, channels=model.channels,
+                num_classes=model.num_classes, d_model=model.d_model,
+                num_heads=model.num_heads, num_blocks=model.num_blocks,
+                mlp_ratio=model.mlp_dim // model.d_model,
+                compute_dtype=model.compute_dtype, seq_axis=MODEL_AXIS,
+                remat=model.remat)
         mesh = make_mesh(MeshSpec(data=-1, model=model_axis))
         if n_procs > 1:
             # the token ("model") axis must stay within a host: staging
@@ -232,10 +284,17 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         feed_batch = local_batch_size(FLAGS.batch_size)
         state = replicate_state(mesh, state)
         step_fn = make_sp_train_step(sp_model, opt, mesh,
-                                     keep_prob=FLAGS.keep_prob)
-        eval_fn = make_sp_eval_step(sp_model, mesh)
-        stage = lambda b: stage_batch_sp(
-            mesh, (reshape_for_sp(sp_model, b[0]), b[1]))
+                                     keep_prob=FLAGS.keep_prob,
+                                     per_token_targets=is_lm)
+        eval_fn = make_sp_eval_step(sp_model, mesh,
+                                    per_token_targets=is_lm)
+        if is_lm:
+            # LM batches are already (B, S) tokens + (B, S) targets
+            stage = lambda b: stage_batch_sp(mesh, b,
+                                             per_token_targets=True)
+        else:
+            stage = lambda b: stage_batch_sp(
+                mesh, (reshape_for_sp(sp_model, b[0]), b[1]))
         restage = lambda s: replicate_state(mesh, s)
     elif mode == "sync" and model_axis > 1:
         # tensor parallelism (+DP on the remaining devices): GSPMD layout,
@@ -309,6 +368,12 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
 
     use_device_data = bool(getattr(FLAGS, "device_data", False))
     if use_device_data:
+        if is_lm:
+            raise ValueError(
+                "--device_data is not wired for --dataset lm yet: the "
+                "resident sampler stages (images, labels) splits; token "
+                "sequences feed through the host pipeline (whose per-"
+                "step bytes are tiny — S int32 tokens per example)")
         if jax.process_count() > 1 and mesh is None:
             raise ValueError(
                 "--device_data under multi-process requires sync mode "
@@ -437,7 +502,9 @@ def evaluate_only(FLAGS) -> dict[str, float]:
             f"--eval_only: no checkpoint found in --logdir={FLAGS.logdir!r}"
         )
     ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
-                        seed=FLAGS.seed)
+                        seed=FLAGS.seed,
+                        seq_len=getattr(FLAGS, "seq_len", 256),
+                        vocab_size=getattr(FLAGS, "vocab_size", 64))
     model = build_model_for(FLAGS, ds.meta)
     variables = model.init(jax.random.PRNGKey(FLAGS.seed))
     if getattr(model, "stateful", False):
