@@ -103,7 +103,8 @@ def reshape_for_sp(model, x):
 
 def make_sp_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
                        donate: bool = True,
-                       per_token_targets: bool = False):
+                       per_token_targets: bool = False,
+                       grad_transform=None, accum_steps: int = 1):
     """Compiled sequence-parallel train step: (state, staged batch) ->
     (state, metrics).
 
@@ -111,6 +112,13 @@ def make_sp_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
     ring-attends over that axis). State (params + opt slots) replicates.
     ``per_token_targets`` matches ``stage_batch_sp``'s: the LM's (B, S)
     targets are sharded over the token axis like the inputs.
+    ``grad_transform`` (e.g. global-norm clip) runs on the FULLY
+    aggregated grads — after both pmeans, identically on every device —
+    and ``accum_steps`` splits the shard's batch slice into microbatches
+    before the one reduction+update (``train_state.compute_grads``):
+    both are pure post-reduction/pre-reduction transforms with no SP
+    interaction, which is why they compose here exactly as in the DP
+    step.
     """
     if getattr(model, "seq_axis", None) != MODEL_AXIS:
         raise ValueError(
@@ -128,7 +136,7 @@ def make_sp_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
 
         grads, shard_metrics, model_state = compute_grads(
             model, state.params, batch, keep_prob=keep_prob, rng=sub,
-            model_state=state.model_state,
+            model_state=state.model_state, accum_steps=accum_steps,
         )
         # ONE uniform pmean over the sequence axis is exact for EVERY
         # parameter and BOTH loss families — see the module docstring's
@@ -139,6 +147,8 @@ def make_sp_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
         # tests/test_attention.py and tests/test_lm.py pin both.
         grads = lax.pmean(grads, MODEL_AXIS)
         grads = lax.pmean(grads, DATA_AXIS)
+        if grad_transform is not None:
+            grads = grad_transform(grads)
         # metrics: pooled-classifier metrics are replicated over the
         # sequence axis (pmean = identity); per-token metrics are
         # shard-local token means that NEED the sequence pmean to be

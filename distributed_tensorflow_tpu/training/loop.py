@@ -138,6 +138,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     n_chips = 1
     mesh = None
     restage = None  # re-place a host-restored state onto the mesh layout
+    sp_full_eval = None  # SP: full-split evals through the sharded step
     feed_batch = FLAGS.batch_size  # examples this process loads per step
     model_axis = max(1, getattr(FLAGS, "model_axis", 1))
     if model_axis > 1 and mode != "sync":
@@ -225,20 +226,23 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 "--attn_block (local blockwise attention) and "
                 "--seq_parallel (ring attention) are mutually exclusive "
                 "attention flavors — the SP step ring-attends; drop one")
+        # the two flags SP genuinely cannot compose with (each justified
+        # in its error text); --accum_steps and --clip_norm DO compose —
+        # they are pre-reduction/post-reduction gradient transforms with
+        # no SP interaction (make_sp_train_step wires them like DP's)
         for flag, why in (
-            ("device_data", "the device-resident sampler has no token "
-                            "sharding"),
-            ("augment", "augmentation expects the image layout"),
+            ("device_data", "the resident sampler stages flat (images, "
+                            "labels) splits and draws (B, F) batches "
+                            "in-program — it has no (B, S, token) tiling "
+                            "to hand the token axis, and rewriting its "
+                            "on-device gather to emit SP tiles is the "
+                            "open item, not a flag toggle"),
+            ("augment", "augmentation crops/flips the image layout; "
+                        "token blocks have no spatial structure"),
         ):
             if getattr(FLAGS, flag, False):
                 raise ValueError(f"--{flag} is not supported with "
                                  f"--seq_parallel ({why})")
-        if accum > 1:
-            raise ValueError("--accum_steps>1 is not supported with "
-                             "--seq_parallel")
-        if clip is not None:
-            raise ValueError("--clip_norm is not supported with "
-                             "--seq_parallel")
 
         if is_lm:
             # the SP twin ring-attends causally; identical params/math
@@ -281,11 +285,18 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             raise ValueError(
                 f"--batch_size={FLAGS.batch_size} must be divisible by "
                 f"the {data_ways}-way data axis")
+        if accum > 1 and (FLAGS.batch_size // data_ways) % accum:
+            raise ValueError(
+                f"each data shard's slice "
+                f"({FLAGS.batch_size // data_ways} examples) must split "
+                f"into {accum} equal microbatches")
         feed_batch = local_batch_size(FLAGS.batch_size)
         state = replicate_state(mesh, state)
         step_fn = make_sp_train_step(sp_model, opt, mesh,
                                      keep_prob=FLAGS.keep_prob,
-                                     per_token_targets=is_lm)
+                                     per_token_targets=is_lm,
+                                     grad_transform=clip,
+                                     accum_steps=accum)
         eval_fn = make_sp_eval_step(sp_model, mesh,
                                     per_token_targets=is_lm)
         if is_lm:
@@ -296,6 +307,14 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             stage = lambda b: stage_batch_sp(
                 mesh, (reshape_for_sp(sp_model, b[0]), b[1]))
         restage = lambda s: replicate_state(mesh, s)
+        if n_procs == 1:
+            # periodic + final full-split evals run THROUGH the sharded
+            # eval step on the live mesh state (the dense twin only
+            # serves display evals and multi-host runs, where each
+            # process holds its own split and the collective step has
+            # no coherent global batch)
+            sp_full_eval = _make_sp_full_split_eval(eval_fn, stage,
+                                                    data_ways)
     elif mode == "sync" and model_axis > 1:
         # tensor parallelism (+DP on the remaining devices): GSPMD layout,
         # XLA inserts the collectives — parallel/tensor_parallel.py
@@ -396,7 +415,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                            task_index=FLAGS.task_index)
     meter = Throughput(FLAGS.batch_size, n_chips)
     last_display = {}
-    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
+    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
+                                        full_eval=sp_full_eval)
 
     coord = (_HostCoordinator(sv, coord_steps_from_flags(FLAGS))
              if (mode == "sync" and n_procs > 1) else None)
@@ -464,7 +484,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             batches.close()
 
     test_metrics = _final_test_eval(FLAGS, sv, periodic_eval, model, state,
-                                    ds, logger, step)
+                                    ds, logger, step,
+                                    full_eval=sp_full_eval)
     print("Optimization Finished!")
     logger.close()
     return TrainResult(
@@ -528,7 +549,8 @@ def evaluate_only(FLAGS) -> dict[str, float]:
         template["model_state"] = state_t
     blob, step = restore_latest(FLAGS.logdir, template)
     m = evaluate(model, blob["params"], ds.test,
-                 model_state=blob.get("model_state", ()))
+                 model_state=blob.get("model_state", ()),
+                 batch_size=_eval_batch_for(model, ds.meta))
     print(f"step: {step} test accuracy: {m['accuracy']} "
           f"test loss: {m['loss']}")
     import json
@@ -539,7 +561,66 @@ def evaluate_only(FLAGS) -> dict[str, float]:
     return m
 
 
-def _periodic_test_eval(FLAGS, sv, model, ds, logger):
+def _make_sp_full_split_eval(sp_eval_fn, stage, data_ways: int,
+                             batch_size: int = 512):
+    """Full-split evaluation THROUGH the sharded SP eval step, using the
+    live on-mesh state (no host fetch, no dense-twin forward): the
+    memory property that justifies SP holds during evaluation too.
+
+    Single-process only — the sharded step is a collective over the
+    global mesh, and in multi-host runs each process holds its OWN
+    seeded split, so there is no coherent global batch to assemble; the
+    multi-host path keeps the host-side twin eval (memory-safe for the
+    LM via its blockwise form).
+
+    Remainder exactness: batches are quantized to the data axis; a final
+    tail smaller than ``data_ways`` is evaluated by REPLICATING each
+    tail example ``data_ways`` times — the mean over the replicated
+    batch equals the mean over the tail exactly (equal per-example
+    weights), so the weighted full-split metrics match the dense
+    evaluation bit-for-bit in exact arithmetic."""
+    import numpy as np
+
+    def full_eval(state, split):
+        xs_all, ys_all = split.images, split.labels
+        n = len(xs_all)
+        bs = max(data_ways, batch_size - batch_size % data_ways)
+        total = {"loss": 0.0, "accuracy": 0.0}
+        seen = 0
+        i = 0
+        while i < n:
+            take = min(bs, n - i)
+            take -= take % data_ways
+            if take == 0:  # tail shorter than the data axis: replicate
+                w = n - i
+                xs = np.repeat(xs_all[i:], data_ways, axis=0)
+                ys = np.repeat(ys_all[i:], data_ways, axis=0)
+                i = n
+            else:
+                w = take
+                xs, ys = xs_all[i:i + take], ys_all[i:i + take]
+                i += take
+            m = sp_eval_fn(state.params, stage((xs, ys)),
+                           state.model_state)
+            total = {k: total[k] + float(m[k]) * w for k in total}
+            seen += w
+        return {k: v / max(seen, 1) for k, v in total.items()}
+
+    return full_eval
+
+
+def _eval_batch_for(model, meta: dict) -> int:
+    """Full-split evaluation batch size. The image-era default of 1000
+    examples per eval batch is ~3 MB of activations; at LM context
+    lengths the same 1000 is GIGABYTES (B*S*d activations + B*S*V
+    logits — the 4k-context OOM this fixes). Scale so B*S stays
+    ~256k tokens per eval batch."""
+    if meta.get("kind") == "lm":
+        return max(1, (1 << 18) // int(model.seq_len))
+    return 1000
+
+
+def _periodic_test_eval(FLAGS, sv, model, ds, logger, full_eval=None):
     """(state, step) -> None: full held-out evaluation every
     ``--eval_step`` steps (crossing semantics, so chunked loops that jump
     several steps per dispatch still evaluate once per boundary). Chief
@@ -589,9 +670,15 @@ def _periodic_test_eval(FLAGS, sv, model, ds, logger):
                     # one-sided collective)
                     state_box["last"] = (step, None)
             return
-        params = fetch_pytree(state.params)
-        model_state = fetch_pytree(state.model_state)
-        m = evaluate(model, params, split, model_state=model_state)
+        if full_eval is not None:
+            # sharded SP eval on the live mesh state — no host fetch,
+            # no dense-twin forward (single-process SP path)
+            m = full_eval(state, split)
+        else:
+            params = fetch_pytree(state.params)
+            model_state = fetch_pytree(state.model_state)
+            m = evaluate(model, params, split, model_state=model_state,
+                         batch_size=_eval_batch_for(model, ds.meta))
         if not use_validation:
             # end-of-run reuse is only sound when this WAS the test split;
             # chief and non-chief must gate identically or the final
@@ -613,7 +700,8 @@ def _periodic_test_eval(FLAGS, sv, model, ds, logger):
     return maybe_eval
 
 
-def _final_test_eval(FLAGS, sv, periodic_eval, model, state, ds, logger, step):
+def _final_test_eval(FLAGS, sv, periodic_eval, model, state, ds, logger,
+                     step, full_eval=None):
     """End-of-run test evaluation (both loops): reuses the periodic eval's
     result when it already covered the final step. In multi-process runs
     the non-chief hosts only contribute the collective state fetch (when
@@ -647,10 +735,16 @@ def _final_test_eval(FLAGS, sv, periodic_eval, model, state, ds, logger, step):
                 join_collective_fetch(state.params)
                 join_collective_fetch(state.model_state)
             return None
-        params = fetch_pytree(state.params)
-        model_state = fetch_pytree(state.model_state)
-        test_metrics = evaluate(model, params, ds.test,
-                                model_state=model_state)
+        if full_eval is not None:
+            # sharded SP eval on the live mesh state (single-process)
+            test_metrics = full_eval(state, ds.test)
+        else:
+            params = fetch_pytree(state.params)
+            model_state = fetch_pytree(state.model_state)
+            test_metrics = evaluate(model, params, ds.test,
+                                    model_state=model_state,
+                                    batch_size=_eval_batch_for(model,
+                                                               ds.meta))
         logger.scalars(step, {"test_accuracy": test_metrics["accuracy"],
                               "test_loss": test_metrics["loss"]})
     print("test accuracy: ", test_metrics["accuracy"],

@@ -254,6 +254,34 @@ def test_seq_parallel_mode_rejections(tmp_path):
                         "--device_data"), mode="sync")
         with pytest.raises(ValueError, match="not supported with"):
             train(parse("--model=transformer", "--model_axis=4",
-                        "--accum_steps=2"), mode="sync")
+                        "--augment"), mode="sync")
+    finally:
+        flags.FLAGS._reset()
+
+
+def test_seq_parallel_composes_accum_clip_eval(tmp_path, capsys):
+    """The round-3 fence is down: --accum_steps, --clip_norm,
+    --eval_step and --validation_size all compose with --seq_parallel
+    (pre-/post-reduction gradient transforms and the sharded full-split
+    evaluator — no dense-twin forward in the periodic/final evals)."""
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    flags.FLAGS._reset()
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+        "--model=transformer", "--seq_parallel", "--model_axis=4",
+        "--training_iter=6", "--batch_size=16", "--display_step=3",
+        "--accum_steps=2", "--clip_norm=1.0", "--eval_step=3",
+        "--validation_size=64", "--optimizer=adam",
+        "--save_model_secs=100000",
+    ])
+    try:
+        res = train(flags.FLAGS, mode="sync")
+        out = capsys.readouterr().out
+        assert res.final_step == 6
+        assert "validation accuracy" in out  # periodic evals ran, on val
+        assert res.test_metrics is not None  # final eval on test
     finally:
         flags.FLAGS._reset()

@@ -336,3 +336,72 @@ def test_lm_validation_split_any_size():
     ds = read_data_sets("", dataset="lm", seq_len=16, vocab_size=16,
                         validation_size=600)
     assert ds.validation.num_examples == 600
+
+
+def test_sp_accum_and_clip_match_dense():
+    """--accum_steps and --clip_norm compose with the SP step EXACTLY:
+    accumulation is a pre-reduction mean over microbatches and clip a
+    post-reduction transform, so SP+accum+clip must track the dense
+    step with the same accum+clip."""
+    from distributed_tensorflow_tpu.training.train_state import (
+        clip_by_global_norm,
+    )
+
+    V, S, B = 16, 32, 8
+    clip = clip_by_global_norm(0.05)  # tight enough to bind every step
+    dense = TransformerLM(vocab_size=V, seq_len=S, d_model=32,
+                          num_heads=2, num_blocks=1)
+    spm = TransformerLM(vocab_size=V, seq_len=S, d_model=32,
+                        num_heads=2, num_blocks=1, seq_axis=MODEL_AXIS)
+    opt = get_optimizer("sgd", 0.5)
+    s_d = create_train_state(dense, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    s_s = replicate_state(mesh, create_train_state(spm, opt, seed=0))
+    step_d = make_train_step(dense, opt, keep_prob=1.0,
+                             grad_transform=clip, accum_steps=2)
+    step_s = make_sp_train_step(spm, opt, mesh, keep_prob=1.0,
+                                per_token_targets=True,
+                                grad_transform=clip, accum_steps=2)
+    ds = LMDataSet(32, seq_len=S, vocab_size=V, seed=1)
+    for _ in range(3):
+        b = ds.next_batch(B)
+        s_d, m_d = step_d(s_d, b)
+        s_s, m_s = step_s(s_s, stage_batch_sp(mesh, b,
+                                              per_token_targets=True))
+        np.testing.assert_allclose(float(m_d["loss"]), float(m_s["loss"]),
+                                   rtol=1e-5)
+    for a, b_ in zip(jax.tree.leaves(jax.device_get(s_d.params)),
+                     jax.tree.leaves(jax.device_get(s_s.params))):
+        np.testing.assert_allclose(a, b_, rtol=3e-4, atol=3e-6)
+
+
+def test_sp_full_split_eval_matches_dense():
+    """The sharded full-split evaluator (periodic/final SP evals) must
+    equal the dense evaluate() on the same split — including a tail
+    smaller than the data axis, which it handles by replication (mean
+    over replicated examples == mean over the tail, exactly)."""
+    from distributed_tensorflow_tpu.training.loop import (
+        _make_sp_full_split_eval,
+    )
+
+    V, S = 16, 32
+    dense = TransformerLM(vocab_size=V, seq_len=S, d_model=32,
+                          num_heads=2, num_blocks=1)
+    spm = TransformerLM(vocab_size=V, seq_len=S, d_model=32,
+                        num_heads=2, num_blocks=1, seq_axis=MODEL_AXIS)
+    opt = get_optimizer("sgd", 0.1)
+    state_d = create_train_state(dense, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    state_s = replicate_state(mesh, create_train_state(spm, opt, seed=0))
+    sp_eval = make_sp_eval_step(spm, mesh, per_token_targets=True)
+    stage = lambda b: stage_batch_sp(mesh, b, per_token_targets=True)
+    # 13 examples, eval batch 8, data_ways 2: one full batch of 8, one
+    # of 4, and a 1-example tail exercising the replication path
+    split = LMDataSet(13, seq_len=S, vocab_size=V, seed=5)
+    full_eval = _make_sp_full_split_eval(sp_eval, stage, data_ways=2,
+                                         batch_size=8)
+    m_sp = full_eval(state_s, split)
+    m_dense = evaluate(dense, state_d.params, split, batch_size=8)
+    np.testing.assert_allclose(m_sp["loss"], m_dense["loss"], rtol=1e-5)
+    np.testing.assert_allclose(m_sp["accuracy"], m_dense["accuracy"],
+                               rtol=1e-6)
