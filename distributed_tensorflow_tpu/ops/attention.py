@@ -65,6 +65,31 @@ def _online_softmax_step(qf, scale, o, m, l, k_blk, v_blk, mask):
     return o, m_new, l
 
 
+def _flash_bwd_block(qf, gf, dD, lse, scale, k_blk, v_blk, mask):
+    """One k/v block of the flash backward — the single implementation
+    both ``_blockwise_bwd`` (local scan) and ``_ring_bwd`` (ring hops)
+    run, mirroring how ``_online_softmax_step`` is the one forward.
+
+    With p = exp(s - lse) the row-exact softmax probs recomputed from
+    the saved logsumexp, and D_i = sum_d(do_i * o_i): dv = p^T do,
+    ds = p * (do @ v^T - D), dq_contrib = ds @ k * scale,
+    dk = ds^T @ q * scale — the textbook softmax-through-attention
+    transpose, one block at a time. Masked entries give p = 0 and drop
+    out of every product. Returns (dq_contrib BQHD, dk_blk, dv_blk)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+    s = s * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jnp.exp(s - lse[..., None])  # masked entries: exp(-inf) = 0
+    dv_blk = jnp.einsum("bhqk,bhqd->bkhd", p, gf)
+    dp = jnp.einsum("bhqd,bkhd->bhqk", gf, v_blk.astype(jnp.float32))
+    ds = p * (dp - dD[..., None])
+    dq_contrib = jnp.einsum("bhqk,bkhd->bqhd", ds,
+                            k_blk.astype(jnp.float32)) * scale
+    dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+    return dq_contrib, dk_blk, dv_blk
+
+
 def blockwise_attention(q, k, v, block_size: int, causal: bool = False):
     """Single-device FLASH attention with O(S * block) peak memory —
     forward AND backward.
@@ -160,20 +185,13 @@ def _blockwise_bwd(block_size, causal, res, g):
 
     def step(dq, inp):
         t, k_blk, v_blk = inp
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
-        s = s * scale
+        mask = None
         if causal:
             cols = t * block_size + jnp.arange(block_size)
             mask = (cols[None, :] <= rows[:, None])[None, None]
-            s = jnp.where(mask, s, -jnp.inf)
-        p = jnp.exp(s - lse[..., None])  # masked entries: exp(-inf)=0
-        dv_blk = jnp.einsum("bhqk,bhqd->bkhd", p, gf)
-        dp = jnp.einsum("bhqd,bkhd->bhqk", gf, v_blk.astype(jnp.float32))
-        ds = p * (dp - dD[..., None])
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds,
-                             k_blk.astype(jnp.float32)) * scale
-        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
-        return dq, (dk_blk, dv_blk)
+        dq_c, dk_blk, dv_blk = _flash_bwd_block(
+            qf, gf, dD, lse, scale, k_blk, v_blk, mask)
+        return dq + dq_c, (dk_blk, dv_blk)
 
     dq0 = jnp.zeros((b, sq, h, dh), jnp.float32)
     dq, (dkb, dvb) = lax.scan(step, dq0, (jnp.arange(n_blocks), kb, vb))
@@ -302,18 +320,12 @@ def _ring_bwd(axis_name, causal, res, g):
     def step(carry, t):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
         owner = (me - t) % p_size
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32))
-        s = s * scale
         mask = _ring_mask(causal, owner, k_cur.shape[1], row_global)
-        if mask is not None:
-            s = jnp.where(mask, s, -jnp.inf)
-        p = jnp.exp(s - lse[..., None])  # masked entries: exp(-inf)=0
-        dv_cur = dv_cur + jnp.einsum("bhqk,bhqd->bkhd", p, gf)
-        dp = jnp.einsum("bhqd,bkhd->bhqk", gf, v_cur.astype(jnp.float32))
-        ds = p * (dp - dD[..., None])
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds,
-                             k_cur.astype(jnp.float32)) * scale
-        dk_cur = dk_cur + jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+        dq_c, dk_blk, dv_blk = _flash_bwd_block(
+            qf, gf, dD, lse, scale, k_cur, v_cur, mask)
+        dq = dq + dq_c
+        dk_cur = dk_cur + dk_blk
+        dv_cur = dv_cur + dv_blk
         # rotate blocks AND their gradient accumulators together
         k_cur = lax.ppermute(k_cur, axis_name, perm)
         v_cur = lax.ppermute(v_cur, axis_name, perm)

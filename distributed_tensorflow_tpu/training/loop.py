@@ -245,6 +245,23 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                                  f"--seq_parallel ({why})")
 
         if is_lm:
+            if model.seq_len >= 1024:
+                # host-side evals (display, multi-host periodic/final)
+                # run the TWIN, not the sharded step; at long context a
+                # dense twin would reintroduce the O(S^2) score matrix
+                # the SP/blockwise forms exist to avoid — rebuild it
+                # blockwise (identical math, streamed memory)
+                blk = next((b for b in (512, 256, 128, 64)
+                            if model.seq_len % b == 0), None)
+                if blk is not None:
+                    model = TransformerLM(
+                        vocab_size=model.vocab_size,
+                        seq_len=model.seq_len, d_model=model.d_model,
+                        num_heads=model.num_heads,
+                        num_blocks=model.num_blocks,
+                        mlp_ratio=model.mlp_dim // model.d_model,
+                        compute_dtype=model.compute_dtype,
+                        attn_block=blk, remat=model.remat)
             # the SP twin ring-attends causally; identical params/math
             # to the dense model built above (blockwise/dense forms are
             # its host-side evaluators)
@@ -312,9 +329,12 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             # eval step on the live mesh state (the dense twin only
             # serves display evals and multi-host runs, where each
             # process holds its own split and the collective step has
-            # no coherent global batch)
-            sp_full_eval = _make_sp_full_split_eval(eval_fn, stage,
-                                                    data_ways)
+            # no coherent global batch). Batch scaled by context length
+            # times the data ways — per-DEVICE token budget, same
+            # reasoning as _eval_batch_for's host-path budget.
+            sp_full_eval = _make_sp_full_split_eval(
+                eval_fn, stage, data_ways,
+                batch_size=data_ways * _eval_batch_for(model, ds.meta))
     elif mode == "sync" and model_axis > 1:
         # tensor parallelism (+DP on the remaining devices): GSPMD layout,
         # XLA inserts the collectives — parallel/tensor_parallel.py
@@ -570,8 +590,10 @@ def _make_sp_full_split_eval(sp_eval_fn, stage, data_ways: int,
     Single-process only — the sharded step is a collective over the
     global mesh, and in multi-host runs each process holds its OWN
     seeded split, so there is no coherent global batch to assemble; the
-    multi-host path keeps the host-side twin eval (memory-safe for the
-    LM via its blockwise form).
+    multi-host path keeps the host-side twin eval (for the LM at long
+    context the twin is REBUILT with blockwise attention — identical
+    math, O(S*block) memory — so that path cannot reintroduce the dense
+    O(S^2) wall; see the SP branch in train()).
 
     Remainder exactness: batches are quantized to the data axis; a final
     tail smaller than ``data_ways`` is evaluated by REPLICATING each

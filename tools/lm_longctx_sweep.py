@@ -71,9 +71,17 @@ def run_config(seq_len: int, variant: str, batch: int = 8,
         rec["status"] = "ok"
     except Exception as e:  # noqa: BLE001 — OOM is a datapoint
         msg = str(e)
-        rec["status"] = ("oom" if ("RESOURCE_EXHAUSTED" in msg
-                                   or "Out of memory" in msg
-                                   or "exceeds" in msg) else "error")
+        if ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+                or "exceeds" in msg):
+            rec["status"] = "oom"
+        elif "remote_compile" in msg or "tpu_compile_helper" in msg:
+            # tunneled chips surface compile-stage failures (incl. the
+            # compiler running out of memory for the buffer assignment)
+            # as an opaque HTTP 500 from the compile helper — classify
+            # separately so "the dense wall" stays a queryable datapoint
+            rec["status"] = "compile_failed"
+        else:
+            rec["status"] = "error"
         rec["error"] = msg[:200]
     return rec
 
