@@ -279,16 +279,17 @@ def define_reference_flags():
                    "(the pulled snapshot is one own-push staler — "
                    "async-SGD staleness class). false = serial "
                    "pull/compute/push reference cycle")
-    DEFINE_boolean("ps_mirror", True, "PS mode + sgd only: keep a device-"
-                   "resident mirror of the params and apply each pushed "
-                   "gradient's identical sgd update ON CHIP instead of re-"
+    DEFINE_boolean("ps_mirror", True, "PS mode: keep a device-resident "
+                   "mirror of the params (and, for momentum/adam, the "
+                   "optimizer slots) and replay each pushed gradient's "
+                   "identical ps-side update ON CHIP instead of re-"
                    "pulling + re-uploading the full parameter set every "
-                   "cycle (the dominant transfer). The mirror resyncs from "
-                   "the ps every --ps_resync_steps and immediately when "
-                   "another worker's push is detected (the returned global "
-                   "step skips ahead). Ignored (full-pull cycle) for "
-                   "momentum/adam; =false restores the pull cycle "
-                   "--ps_prefetch controls")
+                   "cycle (the dominant transfer). The mirror resyncs "
+                   "params (+slots) from the ps every --ps_resync_steps "
+                   "and immediately when another worker's push is "
+                   "detected (the returned global step skips ahead); "
+                   "=false restores the pull cycle --ps_prefetch "
+                   "controls")
     DEFINE_integer("ps_resync_steps", 50, "Steps between full parameter "
                    "resyncs in --ps_mirror mode (bounds any numeric drift "
                    "between the ps-side and device-side sgd applies)")

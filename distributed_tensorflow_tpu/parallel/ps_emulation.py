@@ -274,7 +274,12 @@ class _PsOptimizer:
             m += 0.1 * g
             v *= 0.999
             v += 0.001 * g * g
-            scale = self.lr * np.sqrt(1.0 - 0.999**t) / (1.0 - 0.9**t)
+            # scale rounded to f32: the device mirror replays this
+            # update in f32 (x64 is off on the chip), and a float64
+            # scale here would put the two on trajectories a few ulp
+            # apart that the gradient feedback loop then amplifies
+            scale = np.float32(
+                self.lr * np.sqrt(1.0 - 0.999**t) / (1.0 - 0.9**t))
             param -= scale * m / (np.sqrt(v) + 1e-8)
         else:  # unreachable through __init__'s NAMES gate
             raise ValueError(f"_PsOptimizer cannot apply {self.name!r}")
@@ -354,8 +359,23 @@ class PSServer:
                     params = {k: _bf16_encode(v) for k, v in self.params.items()}
                 else:
                     params = {k: v.copy() for k, v in self.params.items()}
-                return {"ok": True, "params": params,
-                        "global_step": self.global_step}
+                out = {"ok": True, "params": params,
+                       "global_step": self.global_step}
+                if msg.get("with_slots"):
+                    # optimizer slots + per-key step counts, for the
+                    # device mirror's momentum/adam replay. ALWAYS f32
+                    # even on the bf16 wire: slots are the accumulated
+                    # state whose precision the whole trajectory rides
+                    # on, and they move only at resync cadence. Flat
+                    # "param::slot" keys — the typed wire frames flat
+                    # dicts of ndarrays (no nested-object serialization
+                    # anywhere in the protocol, by design)
+                    out["slots"] = {
+                        f"{k}::{n}": a.copy()
+                        for k, s in self.optimizer._slots.items()
+                        for n, a in s.items()}
+                    out["t"] = dict(self.optimizer._t)
+                return out
             if op == "push_grads":
                 if not self.initialized:
                     return {"ok": False, "uninitialized": True}
@@ -633,16 +653,25 @@ class PSClient:
             while not self.call(i, {"op": "ping"}).get("initialized"):
                 time.sleep(poll_s)
 
-    def pull_all(self) -> tuple[dict[str, np.ndarray], int]:
+    def pull_all(self, with_slots: bool = False):
         """One full parameter pull, all ps tasks in parallel. With
         wire='bf16' the arrays come back AS bf16 (ml_dtypes) views — the
         dtype the bf16 device boundary wants, at half the upload width;
-        cast to f32 yourself if you need full-width host math."""
+        cast to f32 yourself if you need full-width host math.
+
+        ``with_slots`` additionally returns the ps-side optimizer slots
+        and per-key apply counts (always f32 — see the server's pull) as
+        ``(flat, step, slots, t)``; the device mirror's momentum/adam
+        resync uses them to adopt the ps's authoritative slot state."""
         msg = {"op": "pull"}
         if self.wire == "bf16":
             msg["encoding"] = "bf16"
+        if with_slots:
+            msg["with_slots"] = True
         rs = self._map_tasks(lambda i: (i, self.call(i, dict(msg))))
         flat: dict[str, np.ndarray] = {}
+        slots: dict[str, dict[str, np.ndarray]] = {}
+        t: dict[str, int] = {}
         step = 0
         for i, r in rs:
             if not r.get("ok"):
@@ -651,8 +680,13 @@ class PSClient:
             if self.wire == "bf16":
                 params = {k: _bf16_view(v) for k, v in params.items()}
             flat.update(params)
+            if with_slots:
+                slots.update(r.get("slots", {}))
+                t.update(r.get("t", {}))
             if i == 0:
                 step = r["global_step"]
+        if with_slots:
+            return flat, step, slots, t
         return flat, step
 
     def pull_all_async(self):
@@ -832,18 +866,23 @@ def ps_unsupported_flag_error(FLAGS) -> str | None:
 
 
 class MirrorCycle:
-    """The device-mirror sgd cycle (--ps_mirror) — ONE implementation
+    """The device-mirror cycle (--ps_mirror) — ONE implementation
     driven by both ``run_worker``'s mirror loop and ``bench.py``'s PS
     phase, so the benchmark measures exactly the cycle the product ships.
 
-    Params live ON the chip; each cycle computes grads there, pushes them
-    (the ps applies ApplyGradientDescent parity, MNISTDist.py:149), and
-    applies the IDENTICAL sgd update to the device mirror — no per-cycle
-    pull and no parameter re-upload, which profiling shows is the
-    dominant cost of the full-pull cycle on host-link-bound setups
-    (PERF.md). Software pipeline: the mirror apply consumes grads ON
-    DEVICE, so the device->host grad download can TRAIL one step behind
-    — the host blocks in device_get for step K-1's grads while the chip
+    Params (and, for momentum/adam, optimizer slots + apply counts)
+    live ON the chip; each cycle computes grads there, pushes them (the
+    ps applies its configured optimizer — ApplyGradientDescent parity
+    generalized, MNISTDist.py:149), and replays the IDENTICAL update on
+    the device mirror — no per-cycle pull and no parameter re-upload,
+    which profiling shows is the dominant cost of the full-pull cycle
+    on host-link-bound setups (PERF.md). Slot-carrying optimizers adopt
+    the ps's authoritative slots at every resync
+    (``pull_all(with_slots=True)``) — between resyncs the on-chip
+    replay keeps them on the ps trajectory because it IS the ps math.
+    Software pipeline: the mirror apply consumes grads ON DEVICE, so
+    the device->host grad download can TRAIL one step behind — the
+    host blocks in device_get for step K-1's grads while the chip
     computes step K. Trajectory-exact for single-worker: grads_K are
     computed on mirror state K = ps state K either way; the ps receives
     the same push stream one cycle later.
@@ -859,30 +898,67 @@ class MirrorCycle:
     multi-worker runs thus degrade to a pull per desynced cycle, exactly
     the reference's staleness model."""
 
+    SLOT_NAMES = {"sgd": (), "momentum": ("v",), "adam": ("m", "v")}
+
     def __init__(self, client, grad_fn, compute_template, assignment,
                  learning_rate: float, resync_steps: int = 50,
-                 training_iter: int | None = None, start_step: int = 0):
+                 training_iter: int | None = None, start_step: int = 0,
+                 optimizer: str = "sgd"):
         import functools
 
         import jax.numpy as jnp
 
+        if optimizer not in self.SLOT_NAMES:
+            raise ValueError(f"--ps_mirror cannot replay {optimizer!r}; "
+                             f"supported: {sorted(self.SLOT_NAMES)}")
         self._client = client
         self._grad_fn = grad_fn
         self._template = compute_template
         self._assignment = assignment
         self._resync_steps = max(1, int(resync_steps))
         self._training_iter = training_iter
+        self._opt_name = optimizer
         lr = float(learning_rate)
 
-        @functools.partial(jax.jit, donate_argnums=0)
-        def _apply(params, grads):
-            return jax.tree.map(
-                lambda p, g: p - lr * g.astype(jnp.float32), params, grads)
+        # the on-device replay of _PsOptimizer.apply — SAME math, so
+        # the mirror stays on the ps's trajectory between resyncs.
+        # slots is a {name: tree} dict (empty for sgd), t a tree of
+        # int32 per-leaf apply counts (adam's bias correction)
+        def _apply(params, slots, t, grads):
+            gf = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            if optimizer == "sgd":
+                params = jax.tree.map(lambda p, g: p - lr * g, params, gf)
+            elif optimizer == "momentum":
+                v = jax.tree.map(lambda v, g: 0.9 * v + g,
+                                 slots["v"], gf)
+                params = jax.tree.map(lambda p, v: p - lr * v, params, v)
+                slots = {"v": v}
+            else:  # adam
+                t = jax.tree.map(lambda ti: ti + 1, t)
+                m = jax.tree.map(lambda m, g: 0.9 * m + 0.1 * g,
+                                 slots["m"], gf)
+                v = jax.tree.map(
+                    lambda v, g: 0.999 * v + 0.001 * jnp.square(g),
+                    slots["v"], gf)
 
-        self._apply = _apply
+                def upd(p, m, v, ti):
+                    tf = ti.astype(jnp.float32)
+                    scale = (lr * jnp.sqrt(1.0 - 0.999 ** tf)
+                             / (1.0 - 0.9 ** tf))
+                    return p - scale * m / (jnp.sqrt(v) + 1e-8)
+
+                params = jax.tree.map(upd, params, m, v, t)
+                slots = {"m": m, "v": v}
+            return params, slots, t
+
+        # grads are NOT donated: the pipelined cycle pushes them to the
+        # ps AFTER the on-device apply consumed them
+        self._apply = jax.jit(_apply, donate_argnums=(0, 1, 2))
         # bf16-wire pulls stay half-width to the chip; widen there
         self._upcast = jax.jit(upcast_f32_tree)
         self.dparams = None
+        self._slots = {}
+        self._t = ()
         self._pending = None  # device grads trailing the chip by one step
         self.step = start_step
         self.mirror_step = start_step
@@ -902,7 +978,40 @@ class MirrorCycle:
             self.drain()
             if self._exhausted():
                 return False
-            flat, pull_step = self._client.pull_all()
+            import jax.numpy as jnp
+
+            names = self.SLOT_NAMES[self._opt_name]
+            if names:
+                # slot-carrying optimizers adopt the ps's authoritative
+                # slot state too — a desync means a foreign push evolved
+                # slots the mirror did not replay
+                flat, pull_step, slots_flat, t_flat = (
+                    self._client.pull_all(with_slots=True))
+                # flatten_pytree's dict preserves the template's leaf
+                # order, so key lists map 1:1 onto tree_unflatten leaves
+                tpl_keys = list(flatten_params(self._template))
+
+                def leaf_tree(vals):
+                    return jax.tree_util.tree_unflatten(
+                        jax.tree_util.tree_structure(self._template),
+                        vals)
+
+                self._slots = {
+                    n: jax.device_put(leaf_tree([
+                        # a key with no ps-side slot yet (zero applies
+                        # since init) starts at the optimizer's zeros.
+                        # Wire keys are the flat "param::slot" form
+                        slots_flat.get(
+                            f"{k}::{n}",
+                            np.zeros(np.asarray(flat[k]).shape,
+                                     np.float32))
+                        for k in tpl_keys]))
+                    for n in names}
+                self._t = jax.device_put(leaf_tree(
+                    [jnp.asarray(t_flat.get(k, 0), jnp.int32)
+                     for k in tpl_keys]))
+            else:
+                flat, pull_step = self._client.pull_all()
             self.dparams = self._upcast(
                 unflatten_params(self._template, flat))
             self.step = self.mirror_step = self._last_sync = pull_step
@@ -918,7 +1027,8 @@ class MirrorCycle:
         # optimistic on-device advance; a desync discards the mirror via
         # resync, and the stale pushed grads are exactly the reference's
         # async staleness semantics
-        self.dparams = self._apply(self.dparams, grads)
+        self.dparams, self._slots, self._t = self._apply(
+            self.dparams, self._slots, self._t, grads)
         self.mirror_step += 1
         if self._pending is not None:
             new_step = self._client.push_grads(
@@ -946,7 +1056,8 @@ def _mirror_train_loop(client, FLAGS, train_data, grad_fn, eval_fn,
         client, grad_fn, compute_template, assignment,
         learning_rate=FLAGS.learning_rate,
         resync_steps=getattr(FLAGS, "ps_resync_steps", 50),
-        training_iter=FLAGS.training_iter, start_step=step)
+        training_iter=FLAGS.training_iter, start_step=step,
+        optimizer=FLAGS.optimizer)
     while cyc.maybe_sync():
         batch = train_data.next_batch(FLAGS.batch_size)
         if cyc.mirror_step % FLAGS.display_step == 0:
@@ -1051,10 +1162,13 @@ def run_worker(cluster, FLAGS) -> int:
     if FLAGS.shard_data:
         train_data = ds.train.shard(FLAGS.task_index, cluster.num_tasks("worker"))
 
-    # the device-mirror cycle is exact only for sgd (the mirror replays
-    # the ps's ApplyGradientDescent); momentum/adam keep the full-pull
-    # cycle, whose ps-resident slots the worker cannot replay
-    mirror = bool(getattr(FLAGS, "ps_mirror", True)) and FLAGS.optimizer == "sgd"
+    # the device-mirror cycle replays the ps-side apply on the chip for
+    # every ps optimizer (sgd/momentum/adam — r3 verdict item 3:
+    # momentum/adam used to pay the full param re-upload per cycle);
+    # slot-carrying optimizers adopt the ps's authoritative slots at
+    # every resync (pull_all(with_slots=True))
+    mirror = (bool(getattr(FLAGS, "ps_mirror", True))
+              and FLAGS.optimizer in MirrorCycle.SLOT_NAMES)
     try:
         step = client.get_step()
         if mirror:
