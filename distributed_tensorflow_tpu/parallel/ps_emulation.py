@@ -904,8 +904,6 @@ class MirrorCycle:
                  learning_rate: float, resync_steps: int = 50,
                  training_iter: int | None = None, start_step: int = 0,
                  optimizer: str = "sgd"):
-        import functools
-
         import jax.numpy as jnp
 
         if optimizer not in self.SLOT_NAMES:
@@ -914,6 +912,11 @@ class MirrorCycle:
         self._client = client
         self._grad_fn = grad_fn
         self._template = compute_template
+        # leaf-ordered wire keys + treedef, fixed for the cycle's life:
+        # computed ONCE so slot resyncs never re-fetch template leaves
+        # just to enumerate names (flatten_pytree fetches to host)
+        self._tpl_keys = list(flatten_params(compute_template))
+        self._treedef = jax.tree_util.tree_structure(compute_template)
         self._assignment = assignment
         self._resync_steps = max(1, int(resync_steps))
         self._training_iter = training_iter
@@ -989,12 +992,11 @@ class MirrorCycle:
                     self._client.pull_all(with_slots=True))
                 # flatten_pytree's dict preserves the template's leaf
                 # order, so key lists map 1:1 onto tree_unflatten leaves
-                tpl_keys = list(flatten_params(self._template))
+                tpl_keys = self._tpl_keys
 
                 def leaf_tree(vals):
-                    return jax.tree_util.tree_unflatten(
-                        jax.tree_util.tree_structure(self._template),
-                        vals)
+                    return jax.tree_util.tree_unflatten(self._treedef,
+                                                        vals)
 
                 self._slots = {
                     n: jax.device_put(leaf_tree([
