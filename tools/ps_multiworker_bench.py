@@ -1,0 +1,133 @@
+"""Multi-worker async PS measurement: fan-in, cycle scaling, staleness.
+
+The reference's deployment is N workers hammering the ps
+(MNISTDist.py:94-95,188); this measures how this build's PS emulation
+behaves as worker count grows. Compute runs on CPU (forced — the
+object of measurement is the ps fan-in, dedup table, and the mirror
+desync/resync protocol under contention, not chip throughput; CPU also
+keeps the shared TPU chip clean). Workers are threads, each with its
+own PSClient (own sockets + client id), all driving MirrorCycle in the
+documented multi-worker degraded mode: every foreign push desyncs the
+mirror, forcing a resync pull — the reference's staleness model.
+
+Per N in {1, 2, 4}: aggregate pushes/s, per-worker cycle rate, and the
+observed STALENESS distribution (per push: how many foreign pushes
+landed since this worker's mirror state — ``new_step - my_step - 1``).
+Prints one JSON line per N.
+
+Usage: python tools/ps_multiworker_bench.py [cycles_per_worker]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+
+def main(cycles: int = 60):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from distributed_tensorflow_tpu.data import read_data_sets
+    from distributed_tensorflow_tpu.models import get_model
+    from distributed_tensorflow_tpu.parallel.ps_emulation import (
+        MirrorCycle,
+        PSClient,
+        PSServer,
+        assign_shards,
+        flatten_params,
+        make_grad_fn,
+    )
+
+    ds = read_data_sets("", dataset="mnist")
+    model = get_model("mlp", hidden_units=100)
+    template = model.init(jax.random.PRNGKey(0))
+    flat = flatten_params(template)
+    batch = 64
+
+    for n_workers in (1, 2, 4):
+        server = PSServer(0, "127.0.0.1:0")
+        server.start_background()
+        init_client = PSClient([server.address])
+        assignment = assign_shards(list(flat), 1)
+        init_client.init_params(flat, assignment, optimizer="sgd",
+                                learning_rate=0.01,
+                                num_workers=n_workers)
+
+        grad_fn = make_grad_fn(model, keep_prob=1.0,
+                               devices=jax.devices()[:1])
+        results = [None] * n_workers
+        barrier = threading.Barrier(n_workers)
+
+        errors: list = []
+
+        def worker(widx: int):
+            try:
+                client = PSClient([server.address])
+                data = ds.train.shard(widx, n_workers)
+                cyc = MirrorCycle(client, grad_fn, template, assignment,
+                                  learning_rate=0.01, resync_steps=10**9)
+                cyc.maybe_sync()
+                rng = jax.random.PRNGKey(widx)
+                staleness: list[int] = []
+                desyncs = 0
+                barrier.wait()
+                t0 = time.perf_counter()
+                for i in range(cycles):
+                    before = cyc.step
+                    cyc.run_cycle(data.next_batch(batch),
+                                  jax.random.fold_in(rng, i))
+                    if cyc.step > before:  # a push happened this cycle
+                        staleness.append(cyc.step - before - 1)
+                    if cyc.needs_resync:
+                        desyncs += 1
+                        cyc.maybe_sync()
+                cyc.drain()
+                dt = time.perf_counter() - t0
+                client.close()
+                results[widx] = {"dt": dt, "staleness": staleness,
+                                 "desyncs": desyncs}
+            except Exception as e:  # noqa: BLE001 — reported by main
+                errors.append((widx, repr(e)))
+
+        try:
+            threads = [threading.Thread(target=worker, args=(w,),
+                                        daemon=True)
+                       for w in range(n_workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors or any(r is None for r in results):
+                print(json.dumps({"n_workers": n_workers,
+                                  "errors": errors}), flush=True)
+                continue
+
+            total = server.dispatch({"op": "get_step"})["global_step"]
+            st = np.array(sum((r["staleness"] for r in results), []))
+            wall = max(r["dt"] for r in results)
+            rec = {
+                "n_workers": n_workers,
+                "global_step_total": int(total),
+                "aggregate_pushes_per_sec": round(total / wall, 2),
+                "per_worker_cycles_per_sec": [
+                    round(cycles / r["dt"], 2) for r in results],
+                "desyncs_total": int(sum(r["desyncs"] for r in results)),
+                "staleness_mean": (round(float(st.mean()), 3)
+                                   if len(st) else 0),
+                "staleness_p95": (int(np.percentile(st, 95))
+                                  if len(st) else 0),
+                "staleness_max": int(st.max()) if len(st) else 0,
+            }
+            print(json.dumps(rec), flush=True)
+        finally:
+            init_client.close()
+            server.close()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 60)
