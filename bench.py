@@ -99,6 +99,15 @@ CONVERGE_EVAL_EVERY = 50
 FEEDDICT_BATCH = 128  # the reference's default batch (MNISTDist.py:28)
 FEEDDICT_STEPS = 30
 
+# long-context LM phase: the blockwise-flash production step at 4k
+# tokens (the config the round-4 sweep measured at ~290-310k tok/s and
+# 1.2 GB compiler temp; dense compile-fails at 2x this length)
+LM_SEQ_LEN = 4096
+LM_BATCH = 8
+LM_D_MODEL = 256
+LM_ATTN_BLOCK = 512
+LM_TIMED_STEPS = 20
+
 
 def _sync_every(n_chips: int) -> int:
     """In-flight collective-program cap (see utils.collective_sync_cadence
@@ -318,6 +327,57 @@ def ps_emulation_phase(ds, wire: str = "f32") -> float:
         server.close()
 
 
+def lm_longctx_phase() -> dict:
+    """Long-context causal LM: tokens/sec/chip for the production train
+    step at 4096-token context — blockwise flash attention
+    (--attn_block 512, custom-VJP backward: O(S*block) memory both
+    passes), bf16, adam, batch 8. Also reports the XLA compiler's peak
+    temp allocation for the step (memory_analysis — the evidence that
+    the long-context path's memory claim holds on this hardware; the
+    dense form compile-fails at 2x this length, PERF.md round-4
+    sweep). The reference has no attention at all (images only,
+    MNISTDist.py:68) — this phase records the build's beyond-parity
+    flagship."""
+    from distributed_tensorflow_tpu.data.lm import LMDataSet
+    from distributed_tensorflow_tpu.models.transformer import TransformerLM
+    from distributed_tensorflow_tpu.training import (
+        adam,
+        create_train_state,
+        make_train_step,
+    )
+
+    seq_len, batch, steps = LM_SEQ_LEN, LM_BATCH, LM_TIMED_STEPS
+    model = TransformerLM(vocab_size=64, seq_len=seq_len,
+                          d_model=LM_D_MODEL,
+                          num_heads=4, num_blocks=4,
+                          attn_block=LM_ATTN_BLOCK,
+                          compute_dtype=jnp.bfloat16)
+    opt = adam(1e-3)
+    state = create_train_state(model, opt, seed=0)
+    step = make_train_step(model, opt, keep_prob=1.0)
+    ds = LMDataSet(64, seq_len=seq_len, vocab_size=64, seed=0)
+    b = ds.next_batch(batch)
+    temp_bytes = 0
+    try:
+        compiled = step.lower(state, b).compile()
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            temp_bytes = int(ma.temp_size_in_bytes)
+        runner = compiled
+    except Exception:  # AOT quirks: fall back to the plain jit path
+        runner = step
+    state, m = runner(state, b)
+    float(m["loss"])  # hard readback: clean clock
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = runner(state, ds.next_batch(batch))
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    return {"lm_4k_tokens_per_sec_per_chip": round(steps * batch * seq_len / dt),
+            "lm_4k_step_temp_bytes": temp_bytes,
+            "lm_seq_len": seq_len}
+
+
 def feeddict_baseline_phase(ds, n_chips) -> float:
     """Measured same-machine baseline: the reference's per-step host feed
     (f32 pixels + one-hot f32 labels uploaded synchronously each step,
@@ -473,6 +533,7 @@ def _run_phases():
     with _prng("threefry2x32"):
         ps_rate = ps_emulation_phase(ds)
         ps_rate_bf16 = ps_emulation_phase(ds, wire="bf16")
+    lm = lm_longctx_phase()
 
     print(json.dumps({
         "metric": "mnist_images_per_sec_per_chip",
@@ -490,6 +551,7 @@ def _run_phases():
         "resnet_data_source": resnet_source,
         "ps_emulation_images_per_sec": round(ps_rate, 1),
         "ps_emulation_bf16_images_per_sec": round(ps_rate_bf16, 1),
+        **lm,
         **conv,
         "fashion_test_accuracy": fashion["test_accuracy"],
         "fashion_seconds_to_target": fashion["seconds_to_target"],

@@ -97,3 +97,14 @@ def test_convergence_phase_fashion_target(monkeypatch, ds):
     out = bench.convergence_phase(ds, 1, target_acc=0.5, max_steps=20)
     assert out["target_accuracy"] == 0.5
     assert out["steps_to_target"] is None or out["steps_to_target"] <= 20
+
+
+def test_lm_longctx_phase_runs(monkeypatch):
+    monkeypatch.setattr(bench, "LM_SEQ_LEN", 64)
+    monkeypatch.setattr(bench, "LM_BATCH", 4)
+    monkeypatch.setattr(bench, "LM_D_MODEL", 32)
+    monkeypatch.setattr(bench, "LM_ATTN_BLOCK", 16)
+    monkeypatch.setattr(bench, "LM_TIMED_STEPS", 2)
+    out = bench.lm_longctx_phase()
+    assert out["lm_4k_tokens_per_sec_per_chip"] > 0
+    assert out["lm_seq_len"] == 64
