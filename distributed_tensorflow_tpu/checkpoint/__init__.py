@@ -3,6 +3,8 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     background_save_from_flags,
     max_to_keep_from_flags,
     save_checkpoint,
+    save_checkpoint_sharded,
+    load_flat_sharded,
     restore_latest,
     latest_checkpoint,
 )
@@ -12,6 +14,8 @@ __all__ = [
     "background_save_from_flags",
     "max_to_keep_from_flags",
     "save_checkpoint",
+    "save_checkpoint_sharded",
+    "load_flat_sharded",
     "restore_latest",
     "latest_checkpoint",
 ]

@@ -25,10 +25,31 @@ import time
 
 import numpy as np
 
-from distributed_tensorflow_tpu.utils.pytree import flatten_pytree, unflatten_pytree
+from distributed_tensorflow_tpu.utils.pytree import (
+    _BF16_TAG,
+    flatten_pytree,
+    unflatten_pytree,
+)
 
 _INDEX = "checkpoint"  # index filename, same as TF's
 _PREFIX = "ckpt"
+_SHARD_RE = re.compile(rf"{_PREFIX}-(\d+)\.shard(\d+)-of-(\d+)\.npz")
+_SHARDMETA = "__shardmeta__"
+_SHARD_FORMAT_VERSION = 1
+
+
+def _atomic_npz(directory: str, final: str, arrays: dict) -> None:
+    """tmp + rename so a killed process never leaves a torn file — the
+    one implementation under both checkpoint formats."""
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, final)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
 
 
 def save_checkpoint(directory: str, state, step: int, max_to_keep: int = 5) -> str:
@@ -44,18 +65,183 @@ def _write_flat(directory: str, flat: dict[str, np.ndarray], step: int,
     on a background thread)."""
     os.makedirs(directory, exist_ok=True)
     final = os.path.join(directory, f"{_PREFIX}-{step}.npz")
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **flat)
-        os.replace(tmp, final)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    _atomic_npz(directory, final, flat)
     _write_index(directory, step)
     _gc(directory, max_to_keep)
     return final
+
+
+def _index_spec(index, shape) -> list:
+    """Tuple-of-slices -> [[start, stop], ...] (JSON-safe)."""
+    spec = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        spec.append([start, stop])
+    return spec
+
+
+def save_checkpoint_sharded(directory: str, state, step: int,
+                            max_to_keep: int = 5) -> str:
+    """This process's shard of a cross-host checkpoint — NO collective.
+
+    Every process calls this at the same agreed step (the coordinated-
+    checkpoint rendezvous) and writes ONE file,
+    ``ckpt-{step}.shard{p}-of-{P}.npz``, holding the leaf slices it
+    uniquely owns: for each distinct shard index of each leaf, the
+    LOWEST process index among its holders stores it (replicas dedupe,
+    so the set's total bytes equal the model, not model x replicas).
+    Replaces the monolithic spanning save's
+    process_allgather-O(model)-to-every-host fetch (r3 verdict item 6)
+    with a local device->host copy of 1/P of the state per process.
+    A JSON meta entry (versioned) inside each npz records global shapes
+    and slice placements; ``load_flat_sharded`` reassembles the full
+    flat dict from a COMPLETE set. Atomic per file; an incomplete set
+    (a peer died mid-save) is never considered restorable."""
+    import jax
+
+    from distributed_tensorflow_tpu.utils.pytree import path_key
+
+    p, n = jax.process_index(), jax.process_count()
+    os.makedirs(directory, exist_ok=True)
+    arrays: dict[str, np.ndarray] = {}
+    leaves_meta: dict[str, dict] = {}
+    paths_leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    for path, leaf in paths_leaves:
+        key = path_key(path)
+        entries = []
+        if isinstance(leaf, jax.Array):
+            gshape = tuple(leaf.shape)
+            imap = leaf.sharding.devices_indices_map(gshape)
+            owners: dict[str, int] = {}
+            for d, idx in imap.items():
+                s = str(idx)
+                owners[s] = min(owners.get(s, d.process_index),
+                                d.process_index)
+            stored = set()
+            for sh in leaf.addressable_shards:
+                s = str(sh.index)
+                if owners[s] == p and s not in stored:
+                    stored.add(s)
+                    data = np.asarray(sh.data)
+                    entries.append((_index_spec(sh.index, gshape), data))
+        else:
+            data = np.asarray(leaf)
+            gshape = tuple(data.shape)
+            if p == 0:  # host/replicated leaf: the chief stores it
+                entries.append(([[0, d] for d in gshape], data))
+        for i, (spec, data) in enumerate(entries):
+            npz_key = f"{key}@{i}"
+            bf16 = data.dtype.name == "bfloat16"  # npz can't store bf16
+            arrays[npz_key] = data.view(np.uint16) if bf16 else data
+            leaves_meta.setdefault(key, {
+                "global_shape": list(gshape), "entries": []})
+            leaves_meta[key]["entries"].append(
+                {"npz": npz_key, "index": spec, "bf16": bool(bf16)})
+
+    meta = {"version": _SHARD_FORMAT_VERSION, "process": p, "n_shards": n,
+            "step": step, "leaves": leaves_meta}
+    arrays[_SHARDMETA] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    final = os.path.join(directory,
+                         f"{_PREFIX}-{step}.shard{p}-of-{n}.npz")
+    _atomic_npz(directory, final, arrays)
+    if p == 0:
+        _write_index(directory, step)
+    _gc(directory, max_to_keep)
+    return final
+
+
+def _scan_shards(directory: str) -> tuple[dict[int, list[str]],
+                                          dict[int, list[str]]]:
+    """One directory pass over shard files.
+
+    Returns ``(complete, all_by_step)``: ``complete[step]`` is the
+    newest COMPLETE shard set's paths — completeness keyed by
+    ``(step, n_shards)`` so sets from different save attempts (a crashed
+    P=4 run restarted at P=2 re-reaching the same step) never merge,
+    and when several complete sets coexist at one step the most
+    recently written wins. ``all_by_step[step]`` is every shard file at
+    that step, complete or orphaned — GC's view."""
+    by_step_n: dict[tuple[int, int], dict[int, str]] = {}
+    all_by_step: dict[int, list[str]] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return {}, {}
+    for name in names:
+        m = _SHARD_RE.fullmatch(name)
+        if m:
+            step, p, n = int(m.group(1)), int(m.group(2)), int(m.group(3))
+            path = os.path.join(directory, name)
+            by_step_n.setdefault((step, n), {})[p] = path
+            all_by_step.setdefault(step, []).append(path)
+    complete: dict[int, tuple[float, list[str]]] = {}
+    for (step, n), by_p in by_step_n.items():
+        if len(by_p) == n and all(i in by_p for i in range(n)):
+            paths = [by_p[i] for i in range(n)]
+            try:
+                mtime = max(os.path.getmtime(p) for p in paths)
+            except OSError:
+                continue  # racing GC deleted part of the set
+            if step not in complete or mtime > complete[step][0]:
+                complete[step] = (mtime, paths)
+    return {s: paths for s, (_, paths) in complete.items()}, all_by_step
+
+
+def _sharded_steps(directory: str) -> dict[int, list[str]]:
+    """{step: [shard paths]} for steps with a complete shard set."""
+    return _scan_shards(directory)[0]
+
+
+def load_flat_sharded(directory: str, step: int) -> dict[str, np.ndarray]:
+    """Reassemble a complete sharded set at ``step`` into the SAME flat
+    path-keyed dict a monolithic checkpoint loads to (bf16 leaves come
+    back under their ``__bf16__`` tag as uint16 views), so every
+    consumer — restore, --eval_only, inspect — reads both formats
+    through one code path."""
+    paths = _sharded_steps(directory).get(step)
+    if not paths:
+        raise FileNotFoundError(
+            f"no complete sharded checkpoint at step {step} in "
+            f"{directory!r}")
+    parts: dict[str, dict] = {}
+    for path in paths:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z[_SHARDMETA]).decode())
+            if meta.get("version") != _SHARD_FORMAT_VERSION:
+                raise ValueError(
+                    f"{path}: sharded-checkpoint format version "
+                    f"{meta.get('version')} (this build reads "
+                    f"{_SHARD_FORMAT_VERSION})")
+            for key, info in meta["leaves"].items():
+                dst = parts.setdefault(key, {
+                    "global_shape": tuple(info["global_shape"]),
+                    "entries": []})
+                for e in info["entries"]:
+                    dst["entries"].append(
+                        (e["index"], z[e["npz"]], e["bf16"]))
+    flat: dict[str, np.ndarray] = {}
+    for key, info in parts.items():
+        gshape = info["global_shape"]
+        entries = info["entries"]
+        if not entries:
+            raise ValueError(f"sharded checkpoint step {step}: no data "
+                             f"for leaf {key!r}")
+        out = np.zeros(gshape, dtype=entries[0][1].dtype)
+        covered = 0
+        bf16 = entries[0][2]
+        for spec, data, _ in entries:
+            sl = tuple(slice(s, e) for s, e in spec)
+            out[sl] = data
+            covered += data.size
+        if covered != out.size:
+            raise ValueError(
+                f"sharded checkpoint step {step}: leaf {key!r} covers "
+                f"{covered} of {out.size} elements — set incomplete or "
+                f"overlapping")
+        flat[(_BF16_TAG + key) if bf16 else key] = out
+    return flat
 
 
 def _write_index(directory: str, step: int):
@@ -66,25 +252,69 @@ def _write_index(directory: str, step: int):
 
 
 def _all_steps(directory: str) -> list[int]:
-    steps = []
+    """Restorable steps: monolithic files plus COMPLETE sharded sets."""
+    steps = set()
     for name in os.listdir(directory):
         m = re.fullmatch(rf"{_PREFIX}-(\d+)\.npz", name)
         if m:
-            steps.append(int(m.group(1)))
+            steps.add(int(m.group(1)))
+    steps.update(_sharded_steps(directory))
     return sorted(steps)
 
 
 def _gc(directory: str, max_to_keep: int):
-    steps = _all_steps(directory)
-    for s in steps[:-max_to_keep]:
-        try:
-            os.unlink(os.path.join(directory, f"{_PREFIX}-{s}.npz"))
-        except OSError:
-            pass
+    """Drop files past the retention horizon, both formats — including
+    ORPHANED shard files from incomplete sets (a peer that died
+    mid-save), which would otherwise accumulate forever and seed
+    same-step/different-n collisions. All coordinated processes run
+    this against the same dir; the unlink races are benign (missing
+    files ignored) and only steps strictly older than the newest
+    ``max_to_keep`` RESTORABLE steps are ever touched — the coordinated
+    cadence means nobody is still writing those. One directory scan."""
+    complete, all_shards = _scan_shards(directory)
+    mono = set()
+    for name in os.listdir(directory):
+        m = re.fullmatch(rf"{_PREFIX}-(\d+)\.npz", name)
+        if m:
+            mono.add(int(m.group(1)))
+    restorable = sorted(mono | set(complete))
+    keep = set(restorable[-max_to_keep:])
+    horizon = min(keep) if keep else None
+    for s in restorable:
+        if s in keep:
+            continue
+        for path in ([os.path.join(directory, f"{_PREFIX}-{s}.npz")]
+                     + all_shards.get(s, [])):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    # orphaned incomplete sets older than the retention horizon
+    for s, paths in all_shards.items():
+        if s in complete or s in mono or (horizon is not None
+                                          and s >= horizon):
+            continue
+        for path in paths:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+def _step_available(directory: str, step: int) -> str | None:
+    """Path representing ``step`` if restorable: the monolithic npz, or
+    the shard-0 file of a complete sharded set."""
+    p = os.path.join(directory, f"{_PREFIX}-{step}.npz")
+    if os.path.exists(p):
+        return p
+    shard_set = _sharded_steps(directory).get(step)
+    return shard_set[0] if shard_set else None
 
 
 def latest_checkpoint(directory: str) -> tuple[str, int] | None:
-    """(path, step) of the newest complete checkpoint, or None."""
+    """(path, step) of the newest complete checkpoint, or None. For a
+    sharded set the path is its shard-0 file — load through
+    ``load_flat`` (which dispatches on the name), not a bare np.load."""
     if not os.path.isdir(directory):
         return None
     idx = os.path.join(directory, _INDEX)
@@ -92,28 +322,61 @@ def latest_checkpoint(directory: str) -> tuple[str, int] | None:
         try:
             with open(idx) as f:
                 step = json.load(f)["latest_step"]
-            p = os.path.join(directory, f"{_PREFIX}-{step}.npz")
-            if os.path.exists(p):
+            p = _step_available(directory, step)
+            if p is not None:
                 return p, step
         except (json.JSONDecodeError, KeyError, OSError):
             pass
-    steps = _all_steps(directory)  # index torn/missing: fall back to files
-    if not steps:
-        return None
-    step = steps[-1]
-    return os.path.join(directory, f"{_PREFIX}-{step}.npz"), step
+    # index torn/missing: fall back to files, newest first. Re-check
+    # availability per step — a peer's concurrent GC can delete a step
+    # between the listing and the pick
+    for step in reversed(_all_steps(directory)):
+        p = _step_available(directory, step)
+        if p is not None:
+            return p, step
+    return None
+
+
+def load_flat(path: str) -> dict[str, np.ndarray]:
+    """Flat path-keyed arrays from EITHER format: a monolithic npz, or
+    any shard file of a complete sharded set (reassembled)."""
+    m = _SHARD_RE.fullmatch(os.path.basename(path))
+    if m:
+        return load_flat_sharded(os.path.dirname(path) or ".",
+                                 int(m.group(1)))
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+def checkpoint_keys(path: str) -> set[str]:
+    """Stored array keys (bf16 tags included) WITHOUT loading tensor
+    data for the sharded format — layout checks (--eval_only's
+    model_state probe, the ps-layout fallback) read this."""
+    m = _SHARD_RE.fullmatch(os.path.basename(path))
+    if not m:
+        with np.load(path) as z:
+            return set(z.files)
+    keys: set[str] = set()
+    directory = os.path.dirname(path) or "."
+    for shard in _sharded_steps(directory).get(int(m.group(1)), []):
+        with np.load(shard) as z:
+            meta = json.loads(bytes(z[_SHARDMETA]).decode())
+            for key, info in meta["leaves"].items():
+                bf16 = any(e["bf16"] for e in info["entries"])
+                keys.add((_BF16_TAG + key) if bf16 else key)
+    return keys
 
 
 def restore_latest(directory: str, template):
     """Restore the newest checkpoint into the structure of ``template``;
     returns (state, step) or None if no checkpoint exists — the
-    init-or-restore decision the Supervisor makes (MNISTDist.py:169-170)."""
+    init-or-restore decision the Supervisor makes (MNISTDist.py:169-170).
+    Reads both the monolithic and the sharded format."""
     found = latest_checkpoint(directory)
     if found is None:
         return None
     path, step = found
-    with np.load(path) as z:
-        flat = {k: z[k] for k in z.files}
+    flat = load_flat(path)
     try:
         return unflatten_pytree(template, flat), step
     except KeyError as e:
@@ -215,6 +478,20 @@ class Checkpointer:
                   f"{self._error}")
             self._error = None
         path = _write_flat(self.directory, flat, step, self.max_to_keep)
+        self._last_save = time.time()
+        return path
+
+    def save_sharded(self, state, step: int) -> str:
+        """This process's shard of a cross-host checkpoint — EVERY
+        coordinated process calls this (chief or not); each writes its
+        own file, no collective anywhere (see save_checkpoint_sharded).
+        Synchronous: the fetch is 1/P of the model (local shards only),
+        so there is no transfer worth backgrounding. Drains any pending
+        background write on the chief first so the index can't regress."""
+        if self.is_chief:
+            self._drain()
+        path = save_checkpoint_sharded(self.directory, state, step,
+                                       self.max_to_keep)
         self._last_save = time.time()
         return path
 

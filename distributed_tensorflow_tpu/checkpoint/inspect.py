@@ -18,8 +18,7 @@ import sys
 import numpy as np
 
 from distributed_tensorflow_tpu.checkpoint.checkpoint import latest_checkpoint
-
-_BF16_TAG = "__bf16__"
+from distributed_tensorflow_tpu.utils.pytree import _BF16_TAG
 
 
 def load_entries(path: str) -> tuple[dict[str, np.ndarray], set[str]]:
@@ -27,7 +26,10 @@ def load_entries(path: str) -> tuple[dict[str, np.ndarray], set[str]]:
     to float32 (a lossless widening — npz stores them as uint16 views).
     ``undecoded_keys`` names bf16-tagged entries left as raw uint16 views
     because ml_dtypes was unavailable — their values are NOT interpretable
-    as numbers."""
+    as numbers. Reads both the monolithic npz and the sharded format
+    (any shard file of a complete set reassembles the whole state)."""
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import load_flat
+
     try:
         import ml_dtypes
 
@@ -36,16 +38,14 @@ def load_entries(path: str) -> tuple[dict[str, np.ndarray], set[str]]:
         bf16 = None
     out = {}
     undecoded = set()
-    with np.load(path) as z:
-        for k in z.files:
-            arr = z[k]
-            if k.startswith(_BF16_TAG):
-                k = k[len(_BF16_TAG):]
-                if bf16 is not None:
-                    arr = arr.view(bf16).astype(np.float32)
-                else:
-                    undecoded.add(k)
-            out[k] = arr
+    for k, arr in load_flat(path).items():
+        if k.startswith(_BF16_TAG):
+            k = k[len(_BF16_TAG):]
+            if bf16 is not None:
+                arr = arr.view(bf16).astype(np.float32)
+            else:
+                undecoded.add(k)
+        out[k] = arr
     return out, undecoded
 
 

@@ -299,6 +299,15 @@ def define_reference_flags():
                    "decisions with one tiny allgather every this many "
                    "steps (worst-case stop latency = this many extra "
                    "steps). Single-process runs never vote")
+    DEFINE_boolean("sharded_checkpoint", True, "Cross-host-sharded state "
+                   "checkpoints as per-process shard files (each host "
+                   "writes its locally-owned slices; NO allgather — the "
+                   "save moves 1/P of the model per host instead of "
+                   "O(model) to every host). Restore reassembles from "
+                   "the complete set; --eval_only and the inspect CLI "
+                   "read both formats. =false keeps the monolithic "
+                   "single-file format. Locally-fetchable state always "
+                   "writes the monolithic file")
     DEFINE_boolean("async_checkpoint", True, "Write cadenced checkpoints "
                    "from a background thread (the state is fetched to "
                    "host on the training thread, then serialized and "

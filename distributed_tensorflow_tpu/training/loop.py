@@ -429,6 +429,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         save_model_secs=FLAGS.save_model_secs,
         max_to_keep=max_to_keep_from_flags(FLAGS),
         background_save=background_save_from_flags(FLAGS),
+        sharded_spanning=bool(getattr(FLAGS, "sharded_checkpoint", True)),
     )
     logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
                            job_name=FLAGS.job_name or "worker",
@@ -553,10 +554,15 @@ def evaluate_only(FLAGS) -> dict[str, float]:
     else:
         params_t, state_t = variables, ()
 
-    with np.load(found[0]) as z:
-        has_model_state = any(
-            k.removeprefix("__bf16__").startswith("model_state/")
-            for k in z.files)
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        checkpoint_keys,
+    )
+
+    from distributed_tensorflow_tpu.utils.pytree import _BF16_TAG
+
+    has_model_state = any(
+        k.removeprefix(_BF16_TAG).startswith("model_state/")
+        for k in checkpoint_keys(found[0]))
     template = {"params": params_t, "step": 0}
     if state_t != ():
         if not has_model_state:
@@ -882,6 +888,7 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
         save_model_secs=FLAGS.save_model_secs,
         max_to_keep=max_to_keep_from_flags(FLAGS),
         background_save=background_save_from_flags(FLAGS),
+        sharded_spanning=bool(getattr(FLAGS, "sharded_checkpoint", True)),
     )
     logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
                            job_name=FLAGS.job_name or "worker",

@@ -53,6 +53,7 @@ class Supervisor:
         background_save: bool = False,
         final_save_timeout_s: float = 300.0,
         exit_agreement_timeout_s: float = 60.0,
+        sharded_spanning: bool = True,
     ):
         """``background_save`` moves the cadenced checkpoint writes off the
         training thread (the reference Supervisor's Saver ran in background
@@ -69,6 +70,10 @@ class Supervisor:
         # window).
         self.final_save_timeout_s = final_save_timeout_s
         self.exit_agreement_timeout_s = exit_agreement_timeout_s
+        # cross-host-sharded state: per-process shard files (True,
+        # default — no collective in the save) vs the monolithic
+        # allgather-then-chief-writes path (False)
+        self.sharded_spanning = sharded_spanning
         self.checkpointer = Checkpointer(
             logdir, is_chief=is_chief, save_model_secs=save_model_secs,
             max_to_keep=max_to_keep, background=background_save,
@@ -162,7 +167,14 @@ class Supervisor:
         time-bounded caller that abandoned this save either flips the
         gate first (the late-completing fetch discards) or blocks in
         ``cancel()`` until an in-flight write finishes (so the
-        checkpointer is never closed mid-write)."""
+        checkpointer is never closed mid-write).
+
+        Cross-host-sharded state defaults to the SHARDED format
+        (``sharded_spanning``): every process writes its own shard file
+        with its locally-owned slices — NO collective, no O(model)
+        allgather to every host (r3 verdict item 6); restore reassembles
+        from the complete set. ``sharded_spanning=False`` keeps the
+        monolithic allgather-then-chief-writes path."""
         import contextlib as _ctx
 
         from distributed_tensorflow_tpu.utils.pytree import (
@@ -171,6 +183,9 @@ class Supervisor:
             needs_collective_fetch,
         )
 
+        if self.sharded_spanning and needs_collective_fetch(state):
+            self.checkpointer.save_sharded(state, step)
+            return
         if self.is_chief:
             flat = flatten_pytree(state, tag_bf16=True)
             with (cancelled.lock if cancelled is not None
@@ -196,11 +211,14 @@ class Supervisor:
         found = latest_checkpoint(self.checkpointer.directory)
         if found is None:
             return False
-        import numpy as np
+        from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+            checkpoint_keys,
+        )
 
-        with np.load(found[0]) as z:
-            keys = {k[len("__bf16__"):] if k.startswith("__bf16__") else k
-                    for k in z.files}
+        from distributed_tensorflow_tpu.utils.pytree import _BF16_TAG
+
+        keys = {k[len(_BF16_TAG):] if k.startswith(_BF16_TAG) else k
+                for k in checkpoint_keys(found[0])}
         return bool(keys) and all(
             k == "step" or k.startswith("params/") for k in keys
         )
@@ -290,7 +308,7 @@ class Supervisor:
                               "at the same point; all peers skip "
                               "symmetrically)")
                 if proceed and (self.is_chief or needs):
-                    if needs:
+                    if needs and not self.sharded_spanning:
                         # the save's collective fetch gets its own bound
                         # (run_bounded's timeout + grace): even if the
                         # agreement resolved asymmetrically (a peer
