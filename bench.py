@@ -242,7 +242,9 @@ def throughput_phase(ds, n_chips) -> float:
 RESNET_PER_CHIP_BATCH = 512  # measured sweet spot: ~2.5x the 256 rate,
                              # ~tied with 1024 at half the step latency
 RESNET_TIMED_CHUNKS = 4
-RESNET_CHUNK = 10
+RESNET_CHUNK = 50  # r4 trace discipline: chunk=10 left ~1.1 ms/step of
+                   # dispatch amortization on the table (107.7k -> 140.5k
+                   # img/s same-session at chunk=50; PERF.md ResNet section)
 
 
 def resnet_phase(n_chips, data_dir: str = "/tmp/cifar10-data") -> tuple[float, str]:

@@ -1,0 +1,84 @@
+"""Aggregate per-op device time from a jax.profiler trace.
+
+The only reliable per-op instrument on tunneled chips (PERF.md): the
+trace's device "XLA Ops" lane durations sum to the wall, per-op, where
+RPC-latency-polluted microbenchmarks are ~10x wrong. Loads the newest
+``*.trace.json.gz`` under a profile dir, selects the XLA Ops thread,
+and prints a table: op name, calls, total ms, share, bytes accessed.
+
+Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def load_trace(profile_dir: str) -> dict:
+    paths = sorted(
+        glob.glob(os.path.join(profile_dir, "**", "*.trace.json.gz"),
+                  recursive=True),
+        key=os.path.getmtime)
+    if not paths:
+        raise FileNotFoundError(f"no *.trace.json.gz under {profile_dir}")
+    with gzip.open(paths[-1], "rt") as f:
+        return json.load(f)
+
+
+def xla_op_events(trace: dict) -> list[dict]:
+    """Complete events on any thread named 'XLA Ops' (the device lane)."""
+    tid_names: dict[tuple, str] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tid_names[(e.get("pid"), e.get("tid"))] = (
+                e.get("args", {}).get("name", ""))
+    out = []
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "X" and "dur" in e:
+            if "XLA Ops" in tid_names.get((e.get("pid"), e.get("tid")), ""):
+                out.append(e)
+    return out
+
+
+def aggregate(events: list[dict]) -> list[dict]:
+    agg: dict[str, dict] = collections.defaultdict(
+        lambda: {"calls": 0, "us": 0.0, "bytes": 0})
+    for e in events:
+        name = e.get("name", "?")
+        a = agg[name]
+        a["calls"] += 1
+        a["us"] += float(e["dur"])
+        args = e.get("args", {})
+        try:
+            a["bytes"] += int(args.get("bytes_accessed", 0))
+        except (TypeError, ValueError):
+            pass
+    rows = [{"op": k, **v} for k, v in agg.items()]
+    rows.sort(key=lambda r: -r["us"])
+    return rows
+
+
+def main(profile_dir: str, top_n: int = 25) -> None:
+    rows = aggregate(xla_op_events(load_trace(profile_dir)))
+    total_us = sum(r["us"] for r in rows)
+    print(f"total device op time: {total_us / 1e3:.2f} ms "
+          f"across {sum(r['calls'] for r in rows)} op executions")
+    print(f"{'op':<52} {'calls':>6} {'ms':>9} {'share':>6} {'GB':>8}")
+    for r in rows[:top_n]:
+        print(f"{r['op'][:52]:<52} {r['calls']:>6} {r['us'] / 1e3:>9.2f} "
+              f"{r['us'] / total_us:>6.1%} {r['bytes'] / 2**30:>8.2f}")
+    rest = rows[top_n:]
+    if rest:
+        us = sum(r["us"] for r in rest)
+        print(f"{'(other ' + str(len(rest)) + ' ops)':<52} "
+              f"{sum(r['calls'] for r in rest):>6} {us / 1e3:>9.2f} "
+              f"{us / total_us:>6.1%}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 25)
