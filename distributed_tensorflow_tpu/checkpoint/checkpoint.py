@@ -289,10 +289,15 @@ def _gc(directory: str, max_to_keep: int):
                 os.unlink(path)
             except OSError:
                 pass
-    # orphaned incomplete sets older than the retention horizon
+    # orphaned incomplete sets STRICTLY OLDER than the retention
+    # horizon. With no restorable step yet (horizon is None) nothing is
+    # deleted: an "orphan" then is almost certainly a peer's IN-PROGRESS
+    # first save racing this process's GC — deleting it made every save
+    # destroy itself whenever the two writes skewed (observed as an
+    # empty checkpoint dir under load despite clean training runs)
     for s, paths in all_shards.items():
-        if s in complete or s in mono or (horizon is not None
-                                          and s >= horizon):
+        if (s in complete or s in mono or horizon is None
+                or s >= horizon):
             continue
         for path in paths:
             try:

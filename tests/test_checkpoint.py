@@ -243,3 +243,31 @@ def test_sharded_gc_and_inspect(tmp_path):
     rc = describe(os.path.join(str(tmp_path), "ckpt-3.shard0-of-1.npz"),
                   key="params/w")
     assert rc == 0
+
+
+def test_gc_never_deletes_in_progress_first_save(tmp_path):
+    """The race the full-suite run caught: process 0 writes its shard of
+    the FIRST-ever save and runs GC before process 1's shard lands. With
+    no complete set anywhere, the lone shard is indistinguishable from
+    an orphan — GC must leave it (deleting it made every coordinated
+    save destroy itself whenever the two writes skewed)."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.checkpoint import save_checkpoint_sharded
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import _gc
+
+    # forge "p0 wrote its half of a 2-shard set" from a real 1-shard file
+    save_checkpoint_sharded(str(tmp_path), {"w": jnp.arange(4.0)}, step=8)
+    src = os.path.join(str(tmp_path), "ckpt-8.shard0-of-1.npz")
+    half = os.path.join(str(tmp_path), "ckpt-8.shard0-of-2.npz")
+    os.replace(src, half)
+    _gc(str(tmp_path), max_to_keep=5)  # p0's GC, no complete set exists
+    assert os.path.exists(half), "GC deleted an in-progress first save"
+    old_orphan = os.path.join(str(tmp_path), "ckpt-1.shard0-of-2.npz")
+    shutil.copy(half, old_orphan)
+    # once a RESTORABLE step exists, orphans BELOW the horizon go (the
+    # coordinated cadence means nobody is still writing an older step);
+    # the save itself runs GC
+    save_checkpoint_sharded(str(tmp_path), {"w": jnp.arange(4.0)}, step=20)
+    assert not os.path.exists(old_orphan)
+    assert not os.path.exists(half)
