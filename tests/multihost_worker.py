@@ -177,6 +177,20 @@ def run_train_sp(process_id: int, num_processes: int, port: str,
                     "--model_axis=4"))
 
 
+def run_train_sp_lm(process_id: int, num_processes: int, port: str,
+                    outdir: str) -> None:
+    """--seq_parallel --model lm across 2 processes: per-token targets
+    sharded WITH their tokens, causal ring attention over the
+    within-host token axis, the per-token uniform-pmean reduction, and
+    the chief's final checkpoint (SP state replicates, so this is the
+    monolithic format — the sharded format's multihost coverage lives
+    in train_tp_span, whose leaves actually span hosts)."""
+    run_train_loop(process_id, num_processes, port, outdir,
+                   ("--seq_parallel", "--model=lm", "--dataset=lm",
+                    "--model_axis=4", "--seq_len=32", "--vocab_size=16",
+                    "--d_model=32", "--num_heads=2", "--num_blocks=1"))
+
+
 def run_span_mixed_exit(process_id: int, num_processes: int, port: str,
                         outdir: str) -> None:
     """The r3 ADVICE mixed-exit hole: cross-host-sharded state, process 1
@@ -270,6 +284,7 @@ if __name__ == "__main__":
           "train_device": run_train_device, "train_tp": run_train_tp,
           "train_tp_span": run_train_tp_span,
           "train_sp": run_train_sp,
+          "train_sp_lm": run_train_sp_lm,
           "span_mixed_exit": run_span_mixed_exit,
           "train_kill": run_train_kill}[mode]
     fn(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5])

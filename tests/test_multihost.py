@@ -272,3 +272,18 @@ def test_mixed_exit_skips_final_save_without_hanging(tmp_path):
     assert "MIXED_EXIT_CLEAN p0" in outs[0]
     assert "final checkpoint skipped" in outs[0], outs[0][-2000:]
     assert "MIXED_EXIT_RAISED p1" in outs[1]
+
+
+def test_sp_lm_train_loop_multihost(tmp_path):
+    """--seq_parallel --model lm across 2 processes: the causal-LM SP
+    path multihost — per-token targets staged with their tokens, causal
+    ring attention within each host's token axis, per-token pmean
+    reduction, chief's final checkpoint."""
+    outs = _spawn_workers("train_sp_lm", str(tmp_path))
+    for out in outs:
+        assert "TRAIN_OK" in out, out[-2000:]
+        assert "Optimization Finished!" in out, out[-2000:]
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import latest_checkpoint
+
+    found = latest_checkpoint(str(tmp_path / "logs"))
+    assert found is not None and found[1] == 12
