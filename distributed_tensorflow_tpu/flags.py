@@ -220,6 +220,14 @@ def define_reference_flags():
                    "ICI with online-softmax accumulation), per-device "
                    "activation memory stays one token block regardless "
                    "of context length")
+    DEFINE_boolean("sp_span_hosts", False, "--seq_parallel only: allow "
+                   "the token axis to SPAN processes — ring hops between "
+                   "hosts ride DCN, and the context length is no longer "
+                   "bounded by one host's chips. Every process then draws "
+                   "the SAME global batch (shared seed; hosts in a data "
+                   "row hold token-slices of the same sequences) and "
+                   "uploads only its tile. Default: the token axis must "
+                   "stay within each host's chips")
     DEFINE_string("lr_schedule", "constant", "Learning-rate schedule: "
                   "constant|cosine|linear|exponential — evaluated inside "
                   "the compiled step (reference: constant). Decays over "

@@ -91,6 +91,78 @@ def stage_batch_sp(mesh, batch, per_token_targets: bool = False):
     )
 
 
+def _span_tile_slices(sh, shape) -> tuple:
+    """This process's contiguous tile of a global array under ``sh`` —
+    the bounding box of its devices' shard indices, validated (once per
+    (sharding, shape)) to be exactly covered by those shards so the
+    packed-local-data contract cannot silently misplace rows."""
+    import numpy as np
+
+    imap = sh.addressable_devices_indices_map(shape)
+    starts, stops = [], []
+    for d in range(len(shape)):
+        starts.append(min((idx[d].start or 0) for idx in imap.values()))
+        stops.append(max((idx[d].stop if idx[d].stop is not None
+                          else shape[d]) for idx in imap.values()))
+    box = 1
+    for a, b in zip(starts, stops):
+        box *= b - a
+    uniq = {
+        tuple((s.start or 0, s.stop if s.stop is not None else shape[i])
+              for i, s in enumerate(idx))
+        for idx in imap.values()}
+    covered = sum(int(np.prod([e - s for s, e in key])) if key else 1
+                  for key in uniq)
+    if covered != box:
+        raise ValueError(
+            f"process-local devices do not tile a contiguous box "
+            f"(box {box}, covered {covered}); --sp_span_hosts needs "
+            f"a standard-order mesh")
+    return tuple(slice(a, b) for a, b in zip(starts, stops))
+
+
+def make_sp_span_stager(mesh, per_token_targets: bool = False):
+    """Span-host staging (--sp_span_hosts): the token axis crosses
+    process boundaries, so every process holds the SAME global (x, y)
+    batch (same-seed draw — processes in one data row are token-slices
+    of the same sequences) and uploads only ITS tile. Ring hops between
+    the processes' token blocks then ride DCN (``ppermute`` is
+    process-transparent under ``jax.distributed``). The tile slices and
+    their contiguity validation are computed ONCE per array shape (the
+    hot input path re-slices with cached tuples); single-process falls
+    back to plain ``stage_batch_sp`` placement."""
+    import numpy as np
+
+    from distributed_tensorflow_tpu.parallel.mesh import put_global
+
+    xs = NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
+    ys = NamedSharding(mesh, (P(DATA_AXIS, MODEL_AXIS)
+                              if per_token_targets else P(DATA_AXIS)))
+    cache: dict = {}
+
+    def stage(batch):
+        x, y = batch
+        if jax.process_count() == 1:
+            return put_global((xs, ys), (x, y))
+        out = []
+        for arr, sh in ((x, xs), (y, ys)):
+            arr = np.asarray(arr)
+            key = (id(sh), arr.shape)
+            sl = cache.get(key)
+            if sl is None:
+                sl = cache[key] = _span_tile_slices(sh, arr.shape)
+            out.append(jax.make_array_from_process_local_data(
+                sh, arr[sl], arr.shape))
+        return tuple(out)
+
+    return stage
+
+
+def stage_batch_sp_span(mesh, batch, per_token_targets: bool = False):
+    """One-shot form of ``make_sp_span_stager`` (tests / library use)."""
+    return make_sp_span_stager(mesh, per_token_targets)(batch)
+
+
 def reshape_for_sp(model, x):
     """Flat (B, F) pixels -> (B, S, token) BEFORE staging, so the token
     axis exists to shard. A host-side numpy view — staging does the one

@@ -124,7 +124,17 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     per-worker input semantics (``MNISTDist.py:167,178``).
     """
     n_procs = jax.process_count()
-    data_seed = FLAGS.seed + (jax.process_index() if n_procs > 1 else 0)
+    span = bool(getattr(FLAGS, "sp_span_hosts", False))
+    if span and not getattr(FLAGS, "seq_parallel", False):
+        raise ValueError(
+            "--sp_span_hosts only applies to --seq_parallel (it lets the "
+            "ring's token axis cross hosts); without it the flag would "
+            "silently change nothing — drop it or add --seq_parallel")
+    # span mode: every process draws the SAME global batch (hosts in a
+    # data row hold token-slices of the same sequences) — one read with
+    # the shared seed, not a per-process seed discarded later
+    data_seed = FLAGS.seed + (
+        jax.process_index() if (n_procs > 1 and not span) else 0)
     ds = read_data_sets(FLAGS.data_dir, one_hot=True, dataset=FLAGS.dataset,
                         seed=data_seed, validation_size=FLAGS.validation_size,
                         seq_len=getattr(FLAGS, "seq_len", 256),
@@ -199,6 +209,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         )
         from distributed_tensorflow_tpu.parallel.sequence_parallel import (
             make_sp_eval_step,
+            make_sp_span_stager,
             make_sp_train_step,
             reshape_for_sp,
             stage_batch_sp,
@@ -281,13 +292,14 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 compute_dtype=model.compute_dtype, seq_axis=MODEL_AXIS,
                 remat=model.remat)
         mesh = make_mesh(MeshSpec(data=-1, model=model_axis))
-        if n_procs > 1:
+        if n_procs > 1 and not span:
             # the token ("model") axis must stay within a host: staging
             # feeds each process its batch slice with the FULL token
             # axis. Check the MESH rows directly — on real TPU slices
             # device ids follow physical topology, so a size comparison
             # against local_device_count can pass while a row still
-            # mixes processes.
+            # mixes processes. --sp_span_hosts lifts this: the ring's
+            # cross-host hops ride DCN and staging tiles both axes.
             for row in mesh.devices:
                 if len({d.process_index for d in row}) != 1:
                     raise ValueError(
@@ -295,7 +307,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                         f"puts devices from multiple hosts on one token-"
                         f"axis row of the mesh; each host must hold the "
                         f"full sequence — use a model_axis whose rows "
-                        f"stay within one host's chips")
+                        f"stay within one host's chips, or opt into "
+                        f"cross-host ring hops with --sp_span_hosts")
         n_chips = mesh.devices.size
         data_ways = mesh.shape[DATA_AXIS]
         if FLAGS.batch_size % data_ways:
@@ -307,7 +320,11 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 f"each data shard's slice "
                 f"({FLAGS.batch_size // data_ways} examples) must split "
                 f"into {accum} equal microbatches")
-        feed_batch = local_batch_size(FLAGS.batch_size)
+        # span-host staging feeds the FULL global batch on every process
+        # (drawn from the shared-seed dataset built at the top) and
+        # uploads only its tile
+        feed_batch = (FLAGS.batch_size if (span and n_procs > 1)
+                      else local_batch_size(FLAGS.batch_size))
         state = replicate_state(mesh, state)
         step_fn = make_sp_train_step(sp_model, opt, mesh,
                                      keep_prob=FLAGS.keep_prob,
@@ -316,13 +333,18 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                                      accum_steps=accum)
         eval_fn = make_sp_eval_step(sp_model, mesh,
                                     per_token_targets=is_lm)
+        if span and n_procs > 1:
+            stage_impl = make_sp_span_stager(mesh,
+                                             per_token_targets=is_lm)
+        else:
+            stage_impl = lambda b: stage_batch_sp(
+                mesh, b, per_token_targets=is_lm)
         if is_lm:
             # LM batches are already (B, S) tokens + (B, S) targets
-            stage = lambda b: stage_batch_sp(mesh, b,
-                                             per_token_targets=True)
+            stage = stage_impl
         else:
-            stage = lambda b: stage_batch_sp(
-                mesh, (reshape_for_sp(sp_model, b[0]), b[1]))
+            stage = lambda b: stage_impl(
+                (reshape_for_sp(sp_model, b[0]), b[1]))
         restage = lambda s: replicate_state(mesh, s)
         if n_procs == 1:
             # periodic + final full-split evals run THROUGH the sharded

@@ -191,6 +191,21 @@ def run_train_sp_lm(process_id: int, num_processes: int, port: str,
                     "--d_model=32", "--num_heads=2", "--num_blocks=1"))
 
 
+def run_train_sp_span(process_id: int, num_processes: int, port: str,
+                      outdir: str) -> None:
+    """--sp_span_hosts: the token axis SPANS both processes (model_axis=8
+    over 2 procs x 4 devices — ring hops cross the process boundary on
+    every attention), every process draws the SAME global batch and
+    uploads only its tile. The pytest side compares the final
+    checkpoint against a single-process 8-device run of the identical
+    config — the span must be a pure layout change."""
+    run_train_loop(process_id, num_processes, port, outdir,
+                   ("--seq_parallel", "--sp_span_hosts", "--model=lm",
+                    "--dataset=lm", "--model_axis=8", "--seq_len=32",
+                    "--vocab_size=16", "--d_model=32", "--num_heads=2",
+                    "--num_blocks=1", "--keep_prob=1.0", "--seed=7"))
+
+
 def run_span_mixed_exit(process_id: int, num_processes: int, port: str,
                         outdir: str) -> None:
     """The r3 ADVICE mixed-exit hole: cross-host-sharded state, process 1
@@ -285,6 +300,7 @@ if __name__ == "__main__":
           "train_tp_span": run_train_tp_span,
           "train_sp": run_train_sp,
           "train_sp_lm": run_train_sp_lm,
+          "train_sp_span": run_train_sp_span,
           "span_mixed_exit": run_span_mixed_exit,
           "train_kill": run_train_kill}[mode]
     fn(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5])

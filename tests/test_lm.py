@@ -405,3 +405,22 @@ def test_sp_full_split_eval_matches_dense():
     np.testing.assert_allclose(m_sp["loss"], m_dense["loss"], rtol=1e-5)
     np.testing.assert_allclose(m_sp["accuracy"], m_dense["accuracy"],
                                rtol=1e-6)
+
+
+def test_sp_span_flag_requires_seq_parallel(tmp_path):
+    """--sp_span_hosts without --seq_parallel must refuse loudly (the
+    loud-pairing convention), not silently train a different mode."""
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    flags.FLAGS._reset()
+    flags.FLAGS._parse([
+        f"--logdir={tmp_path}/l", f"--data_dir={tmp_path}/n",
+        "--sp_span_hosts", "--model_axis=8", "--training_iter=1",
+    ])
+    try:
+        with pytest.raises(ValueError, match="sp_span_hosts"):
+            train(flags.FLAGS, mode="sync")
+    finally:
+        flags.FLAGS._reset()

@@ -287,3 +287,53 @@ def test_sp_lm_train_loop_multihost(tmp_path):
 
     found = latest_checkpoint(str(tmp_path / "logs"))
     assert found is not None and found[1] == 12
+
+
+def test_sp_span_hosts_matches_single_process(tmp_path):
+    """--sp_span_hosts: token axis across 2 processes (model_axis=8 over
+    2x4 devices — every ring hop crosses the process boundary on DCN).
+    The final checkpoint must match a SINGLE-process 8-device run of
+    the identical config on the same global batches: spanning the hosts
+    is a pure layout change, not a numerics change."""
+    outs = _spawn_workers("train_sp_span", str(tmp_path))
+    for out in outs:
+        assert "TRAIN_OK" in out, out[-2000:]
+
+    # identical config, one process, all 8 local devices
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "jax.config.update('jax_default_matmul_precision', 'highest');"
+        "import runpy, sys;"
+        "sys.argv = ['mnist_dist.py', '--seq_parallel', '--model=lm',"
+        " '--dataset=lm', '--model_axis=8', '--seq_len=32',"
+        " '--vocab_size=16', '--d_model=32', '--num_heads=2',"
+        " '--num_blocks=1', '--keep_prob=1.0', '--seed=7',"
+        " '--training_iter=12', '--batch_size=32', '--display_step=4',"
+        " '--optimizer=adam', '--learning_rate=0.002',"
+        " '--save_model_secs=100000',"
+        f" '--logdir={tmp_path}/logs-single',"
+        f" '--data_dir={tmp_path}/no-data'];"
+        "runpy.run_path('mnist_dist.py', run_name='__main__')"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, "PYTHONPATH": REPO,
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        latest_checkpoint,
+        load_flat,
+    )
+
+    span = latest_checkpoint(str(tmp_path / "logs"))
+    single = latest_checkpoint(str(tmp_path / "logs-single"))
+    assert span is not None and single is not None
+    assert span[1] == single[1] == 12
+    a, b = load_flat(span[0]), load_flat(single[0])
+    keys = [k for k in a if k.startswith("params/")]
+    assert keys and set(keys) == {k for k in b if k.startswith("params/")}
+    for k in keys:
+        np.testing.assert_allclose(a[k], b[k], rtol=3e-4, atol=3e-6,
+                                   err_msg=k)
