@@ -271,3 +271,25 @@ def test_gc_never_deletes_in_progress_first_save(tmp_path):
     save_checkpoint_sharded(str(tmp_path), {"w": jnp.arange(4.0)}, step=20)
     assert not os.path.exists(old_orphan)
     assert not os.path.exists(half)
+
+
+def test_latest_checkpoint_prefers_newest_across_formats(tmp_path):
+    """A newer monolithic step beats an older sharded one and vice
+    versa — the two formats share one step timeline."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.checkpoint import (
+        latest_checkpoint,
+        save_checkpoint,
+        save_checkpoint_sharded,
+    )
+
+    save_checkpoint_sharded(str(tmp_path), {"w": jnp.arange(4.0)}, step=3)
+    save_checkpoint(str(tmp_path), {"w": jnp.arange(4.0)}, step=7)
+    found = latest_checkpoint(str(tmp_path))
+    assert found is not None and found[1] == 7
+    assert found[0].endswith("ckpt-7.npz")
+    save_checkpoint_sharded(str(tmp_path), {"w": jnp.arange(4.0)}, step=9)
+    found = latest_checkpoint(str(tmp_path))
+    assert found is not None and found[1] == 9
+    assert "shard0-of-1" in found[0]

@@ -424,3 +424,14 @@ def test_sp_span_flag_requires_seq_parallel(tmp_path):
             train(flags.FLAGS, mode="sync")
     finally:
         flags.FLAGS._reset()
+
+
+def test_lm_dataset_large_vocab_storage():
+    """vocab > 256 switches to u16 storage; ids round-trip exactly."""
+    ds = LMDataSet(8, seq_len=16, vocab_size=1000, seed=0)
+    x, y = ds.next_batch(4)
+    assert x.dtype == np.int32
+    assert int(x.max()) < 1000 and int(x.min()) >= 0
+    assert ds._tokens.dtype == np.uint16
+    with pytest.raises(ValueError, match="vocab_size"):
+        LMDataSet(4, seq_len=8, vocab_size=1)
