@@ -497,26 +497,184 @@ def convergence_phase(ds, n_chips, target_acc: float | None = None,
     }
 
 
+# Outage resilience (round-4 lesson: the tunnel was down at the driver's
+# capture time and the artifact became rc=1 with a bare stack trace —
+# BENCH_r04.json). Backend init is probed in a SUBPROCESS because during
+# an outage jax.devices() can HANG rather than raise (memory: multi-hour
+# tunnel losses observed) — a hung child can be killed; the in-process
+# call cannot. Bounded retry with backoff, then one parsable degraded
+# JSON line, never a bare stack trace.
+BACKEND_PROBE_TIMEOUT_S = 120
+BACKEND_PROBE_ATTEMPTS = 4
+BACKEND_PROBE_BACKOFF_S = (30.0, 60.0, 120.0)
+
+
+def _probe_backend(timeout_s: float = BACKEND_PROBE_TIMEOUT_S):
+    """(ok, error) — try backend init in a killable child process."""
+    import subprocess
+    import sys
+
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, f"backend init hung > {timeout_s}s (tunnel outage signature)"
+    if p.returncode == 0 and p.stdout.strip().split()[-1:] and \
+            p.stdout.strip().split()[-1].isdigit():
+        return True, ""
+    tail = (p.stderr or p.stdout).strip().splitlines()
+    return False, (tail[-1] if tail else f"probe exit code {p.returncode}")[:300]
+
+
+def _init_backend_with_retry(attempts: int | None = None, backoffs=None,
+                             probe=None, sleep=time.sleep) -> dict:
+    """Bounded retry around backend init. Returns
+    {"ok", "attempts", "waited_s", "error"}; injectable probe/sleep for the
+    forced-outage test. Defaults resolve the module globals at CALL time
+    so tests can monkeypatch them."""
+    attempts = BACKEND_PROBE_ATTEMPTS if attempts is None else attempts
+    backoffs = BACKEND_PROBE_BACKOFF_S if backoffs is None else backoffs
+    probe = probe or _probe_backend
+    waited = 0.0
+    err = ""
+    for a in range(attempts):
+        ok, err = probe()
+        if ok:
+            return {"ok": True, "attempts": a + 1,
+                    "waited_s": round(waited, 1), "error": ""}
+        if a + 1 < attempts:
+            d = backoffs[min(a, len(backoffs) - 1)]
+            sleep(d)
+            waited += d
+    return {"ok": False, "attempts": attempts,
+            "waited_s": round(waited, 1), "error": err}
+
+
+def _cpu_smoke() -> dict:
+    """Host-side proof the tree still executes when the chip is gone: flip
+    this process to the CPU backend (legal only in the init-failure path,
+    where no device API has run yet) and take a few real train steps."""
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        from distributed_tensorflow_tpu.data import read_data_sets
+        from distributed_tensorflow_tpu.models import DeepCNN
+        from distributed_tensorflow_tpu.training import (
+            create_train_state,
+            make_train_step,
+            sgd,
+        )
+
+        ds = read_data_sets("/tmp/mnist-data", one_hot=True)
+        model = DeepCNN()
+        opt = sgd(0.05)
+        state = create_train_state(model, opt, seed=0)
+        step = make_train_step(model, opt, keep_prob=1.0)
+        state, m0 = step(state, ds.train.next_batch(32))
+        first = float(m0["loss"])
+        for _ in range(3):
+            state, m = step(state, ds.train.next_batch(32))
+        return {"ok": True, "platform": jax.devices()[0].platform,
+                "data_source": ds.source,
+                "loss_first": round(first, 4),
+                "loss_last": round(float(m["loss"]), 4)}
+    except Exception as e:  # the smoke must never kill the degraded record
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
+
+
+# the tunneled-chip outage signatures (observed r3-r5); anything else
+# raising mid-run is a SOFTWARE regression and must not be filed as
+# infra flakiness (exit nonzero, "phase_error" not "tpu_unavailable")
+_OUTAGE_SIGNS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "remote_compile",
+                 "read body", "tpu_compile_helper", "Connection reset",
+                 "Socket closed", "backend init hung")
+
+
+def _looks_like_outage(err: str) -> bool:
+    return any(s in err for s in _OUTAGE_SIGNS)
+
+
+def degraded_record(error, init_info: dict, partial: dict | None = None,
+                    cpu_smoke: bool = True,
+                    tpu_unavailable: bool = True) -> dict:
+    """The degraded artifact: same headline keys (null where the chip
+    was required), the error string, and any phase results that
+    completed before the failure (partial overrides the nulls, so a
+    mid-run flap keeps the finished numbers). ``tpu_unavailable=False``
+    marks a SOFTWARE failure instead (``phase_error``) — the driver's
+    outage handling must not swallow real regressions."""
+    out = {
+        "metric": "mnist_images_per_sec_per_chip",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "tpu_unavailable": bool(tpu_unavailable),
+        "phase_error": not tpu_unavailable,
+        "error": str(error)[:300],
+        "init_attempts": init_info.get("attempts"),
+        "init_waited_s": init_info.get("waited_s"),
+    }
+    if partial:
+        out.update(partial)
+    if cpu_smoke:
+        out["cpu_smoke"] = _cpu_smoke()
+    return out
+
+
 def main():
+    init = _init_backend_with_retry()
+    if not init["ok"]:
+        print(json.dumps(degraded_record(init["error"], init)))
+        return
     # the product's fast-PRNG mode (--prng rbg, mnist_dist.py): hardware
     # RNG for dropout masks and on-device batch sampling, ~4% faster steps
     # than threefry (PERF.md sweep). Scoped, and set here rather than at
     # import time: this module is imported by tests, and an unscoped
     # config flip leaks into everything that runs after. The baseline
     # phases are scoped back to threefry inside.
+    partial: dict = {}
     with _prng("rbg"):
-        _run_phases()
+        try:
+            _run_phases(partial)
+        except Exception as e:
+            import sys
+            import traceback
+
+            traceback.print_exc()  # full context on stderr; stdout stays JSON
+            err = f"{type(e).__name__}: {e}"
+            outage = _looks_like_outage(err)
+            print(json.dumps(degraded_record(
+                err, init, partial=partial, cpu_smoke=False,
+                tpu_unavailable=outage)))
+            if not outage:
+                # a software regression mid-phase: the artifact line is
+                # still parsable, but the process must fail loudly so
+                # the driver doesn't file it as infra flakiness
+                sys.exit(1)
 
 
-def _run_phases():
+def _run_phases(out: dict):
+    """Run every phase, accumulating fields into ``out`` as each completes
+    (the caller keeps ``out`` if a later phase dies mid-run), then print
+    the one-line JSON artifact."""
     from distributed_tensorflow_tpu.data import read_data_sets
 
     n_chips = len(jax.devices())
+    out["n_chips"] = n_chips
     ds = read_data_sets("/tmp/mnist-data", one_hot=True)
+    out["data_source"] = ds.source
 
     per_chip = device_resident_phase(ds, n_chips)
-    wire = throughput_phase(ds, n_chips)
-    conv = convergence_phase(ds, n_chips)
+    out.update({
+        "metric": "mnist_images_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / IMPLIED_BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+        "global_batch": PER_CHIP_BATCH * n_chips,
+        "input": "device_resident",
+    })
+    out["wire_images_per_sec_per_chip"] = round(throughput_phase(ds, n_chips), 1)
+    out.update(convergence_phase(ds, n_chips))
     # BASELINE config 3: Fashion-MNIST through the same drop-in loader
     # (reference parity: swap the data_dir, MNISTDist.py:167). Real IDX
     # files when present in /tmp/fashion-mnist-data, else the procedural
@@ -527,40 +685,29 @@ def _run_phases():
     fashion = convergence_phase(ds_fashion, n_chips,
                                 target_acc=FASHION_TARGET_ACC,
                                 max_steps=FASHION_MAX_STEPS)
-    # baseline phases measure the REFERENCE's configuration: keep them on
-    # threefry so the product's rbg speedup can't deflate the comparison
-    with _prng("threefry2x32"):
-        feeddict = feeddict_baseline_phase(ds, n_chips)
-    resnet, resnet_source = resnet_phase(n_chips)
-    with _prng("threefry2x32"):
-        ps_rate = ps_emulation_phase(ds)
-        ps_rate_bf16 = ps_emulation_phase(ds, wire="bf16")
-    lm = lm_longctx_phase()
-
-    print(json.dumps({
-        "metric": "mnist_images_per_sec_per_chip",
-        "value": round(per_chip, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / IMPLIED_BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
-        "n_chips": n_chips,
-        "global_batch": PER_CHIP_BATCH * n_chips,
-        "input": "device_resident",
-        "data_source": ds.source,
-        "wire_images_per_sec_per_chip": round(wire, 1),
-        "feeddict_images_per_sec_per_chip": round(feeddict, 1),
-        "vs_feeddict": round(per_chip / feeddict, 3),
-        "resnet20_cifar10_images_per_sec_per_chip": round(resnet, 1),
-        "resnet_data_source": resnet_source,
-        "ps_emulation_images_per_sec": round(ps_rate, 1),
-        "ps_emulation_bf16_images_per_sec": round(ps_rate_bf16, 1),
-        **lm,
-        **conv,
+    out.update({
         "fashion_test_accuracy": fashion["test_accuracy"],
         "fashion_seconds_to_target": fashion["seconds_to_target"],
         "fashion_steps_to_target": fashion["steps_to_target"],
         "fashion_target_accuracy": fashion["target_accuracy"],
         "fashion_data_source": ds_fashion.source,
-    }))
+    })
+    # baseline phases measure the REFERENCE's configuration: keep them on
+    # threefry so the product's rbg speedup can't deflate the comparison
+    with _prng("threefry2x32"):
+        feeddict = feeddict_baseline_phase(ds, n_chips)
+    out["feeddict_images_per_sec_per_chip"] = round(feeddict, 1)
+    out["vs_feeddict"] = round(per_chip / feeddict, 3)
+    resnet, resnet_source = resnet_phase(n_chips)
+    out["resnet20_cifar10_images_per_sec_per_chip"] = round(resnet, 1)
+    out["resnet_data_source"] = resnet_source
+    with _prng("threefry2x32"):
+        out["ps_emulation_images_per_sec"] = round(ps_emulation_phase(ds), 1)
+        out["ps_emulation_bf16_images_per_sec"] = round(
+            ps_emulation_phase(ds, wire="bf16"), 1)
+    out.update(lm_longctx_phase())
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
