@@ -33,9 +33,38 @@ from distributed_tensorflow_tpu.utils.pytree import (
 
 _INDEX = "checkpoint"  # index filename, same as TF's
 _PREFIX = "ckpt"
-_SHARD_RE = re.compile(rf"{_PREFIX}-(\d+)\.shard(\d+)-of-(\d+)\.npz")
+# optional 8-hex attempt nonce before .npz: shard sets from two save
+# ATTEMPTS at the same (step, n) — a crashed save at step S, then a
+# restart that re-reaches S with the same process count — must never
+# assemble into one "complete" set mixing two trajectories (ADVICE r4).
+# The nonce lives in the FILENAME so completeness stays a pure directory
+# scan (no npz opens). Nonce-less names (older saves) parse with
+# attempt="" and group among themselves — old checkpoints stay readable.
+_SHARD_RE = re.compile(
+    rf"{_PREFIX}-(\d+)\.shard(\d+)-of-(\d+)(?:\.([0-9a-f]{{8}}))?\.npz")
 _SHARDMETA = "__shardmeta__"
 _SHARD_FORMAT_VERSION = 1
+
+
+_ATTEMPT_RE = re.compile(r"[0-9a-f]{8}")
+
+
+def _default_attempt_token() -> str:
+    """Attempt token when the caller supplied none — STRICTLY
+    collective-free (the sharded save's 'no collective anywhere'
+    contract is load-bearing: the supervisor's exit path runs it
+    unbounded). Single-process: a fresh random token. Multi-process:
+    the legacy nonce-less name — per-process random tokens would never
+    assemble into a complete set, and agreeing on one here would need
+    a collective. The PRODUCT paths always pass an agreed token (the
+    coordinator's vote allgather / the bounded exit agreement both
+    carry one); only direct multi-process library calls fall through,
+    keeping their pre-nonce semantics."""
+    import secrets
+
+    import jax
+
+    return secrets.token_hex(4) if jax.process_count() == 1 else ""
 
 
 def _atomic_npz(directory: str, final: str, arrays: dict) -> None:
@@ -82,7 +111,8 @@ def _index_spec(index, shape) -> list:
 
 
 def save_checkpoint_sharded(directory: str, state, step: int,
-                            max_to_keep: int = 5) -> str:
+                            max_to_keep: int = 5,
+                            attempt: str | None = None) -> str:
     """This process's shard of a cross-host checkpoint — NO collective.
 
     Every process calls this at the same agreed step (the coordinated-
@@ -97,12 +127,24 @@ def save_checkpoint_sharded(directory: str, state, step: int,
     A JSON meta entry (versioned) inside each npz records global shapes
     and slice placements; ``load_flat_sharded`` reassembles the full
     flat dict from a COMPLETE set. Atomic per file; an incomplete set
-    (a peer died mid-save) is never considered restorable."""
+    (a peer died mid-save) is never considered restorable — including a
+    set MIXING two save attempts at the same (step, n): every file of a
+    set carries the attempt nonce agreed for that save (``attempt`` —
+    pass the token the coordinator/exit agreement distributed; None
+    falls back collective-free, see _default_attempt_token), and
+    completeness requires the nonce to match."""
     import jax
 
     from distributed_tensorflow_tpu.utils.pytree import path_key
 
     p, n = jax.process_index(), jax.process_count()
+    if attempt is None:
+        attempt = _default_attempt_token()
+    elif attempt and not _ATTEMPT_RE.fullmatch(attempt):
+        # a name the scan regex can't parse would be silently
+        # unrestorable AND invisible to GC — refuse at save time
+        raise ValueError(f"attempt token {attempt!r} must be 8 lowercase "
+                         f"hex chars (or '' for the nonce-less name)")
     os.makedirs(directory, exist_ok=True)
     arrays: dict[str, np.ndarray] = {}
     leaves_meta: dict[str, dict] = {}
@@ -140,11 +182,12 @@ def save_checkpoint_sharded(directory: str, state, step: int,
                 {"npz": npz_key, "index": spec, "bf16": bool(bf16)})
 
     meta = {"version": _SHARD_FORMAT_VERSION, "process": p, "n_shards": n,
-            "step": step, "leaves": leaves_meta}
+            "step": step, "attempt": attempt, "leaves": leaves_meta}
     arrays[_SHARDMETA] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
+    suffix = f".{attempt}" if attempt else ""
     final = os.path.join(directory,
-                         f"{_PREFIX}-{step}.shard{p}-of-{n}.npz")
+                         f"{_PREFIX}-{step}.shard{p}-of-{n}{suffix}.npz")
     _atomic_npz(directory, final, arrays)
     if p == 0:
         _write_index(directory, step)
@@ -158,12 +201,13 @@ def _scan_shards(directory: str) -> tuple[dict[int, list[str]],
 
     Returns ``(complete, all_by_step)``: ``complete[step]`` is the
     newest COMPLETE shard set's paths — completeness keyed by
-    ``(step, n_shards)`` so sets from different save attempts (a crashed
-    P=4 run restarted at P=2 re-reaching the same step) never merge,
-    and when several complete sets coexist at one step the most
-    recently written wins. ``all_by_step[step]`` is every shard file at
-    that step, complete or orphaned — GC's view."""
-    by_step_n: dict[tuple[int, int], dict[int, str]] = {}
+    ``(step, n_shards, attempt)`` so sets from different save attempts
+    (a crashed P=4 run restarted at P=2 re-reaching the same step, or
+    the same P re-saving the same step after a restore: the ADVICE-r4
+    mixing hole) never merge, and when several complete sets coexist at
+    one step the most recently written wins. ``all_by_step[step]`` is
+    every shard file at that step, complete or orphaned — GC's view."""
+    by_key: dict[tuple[int, int, str], dict[int, str]] = {}
     all_by_step: dict[int, list[str]] = {}
     try:
         names = os.listdir(directory)
@@ -173,11 +217,12 @@ def _scan_shards(directory: str) -> tuple[dict[int, list[str]],
         m = _SHARD_RE.fullmatch(name)
         if m:
             step, p, n = int(m.group(1)), int(m.group(2)), int(m.group(3))
+            attempt = m.group(4) or ""
             path = os.path.join(directory, name)
-            by_step_n.setdefault((step, n), {})[p] = path
+            by_key.setdefault((step, n, attempt), {})[p] = path
             all_by_step.setdefault(step, []).append(path)
     complete: dict[int, tuple[float, list[str]]] = {}
-    for (step, n), by_p in by_step_n.items():
+    for (step, n, _attempt), by_p in by_key.items():
         if len(by_p) == n and all(i in by_p for i in range(n)):
             paths = [by_p[i] for i in range(n)]
             try:
@@ -229,17 +274,25 @@ def load_flat_sharded(directory: str, step: int) -> dict[str, np.ndarray]:
             raise ValueError(f"sharded checkpoint step {step}: no data "
                              f"for leaf {key!r}")
         out = np.zeros(gshape, dtype=entries[0][1].dtype)
-        covered = 0
+        # positional coverage mask, not an element count: overlapping
+        # entries plus a gap that coincidentally sums to out.size must
+        # not pass (ADVICE r4) — overlap and gap each fail loudly
+        mask = np.zeros(gshape, dtype=bool)
         bf16 = entries[0][2]
         for spec, data, _ in entries:
             sl = tuple(slice(s, e) for s, e in spec)
+            if mask[sl].any():
+                raise ValueError(
+                    f"sharded checkpoint step {step}: leaf {key!r} has "
+                    f"overlapping entries at {spec} — set mixes save "
+                    f"attempts")
             out[sl] = data
-            covered += data.size
-        if covered != out.size:
+            mask[sl] = True
+        if not mask.all():
             raise ValueError(
                 f"sharded checkpoint step {step}: leaf {key!r} covers "
-                f"{covered} of {out.size} elements — set incomplete or "
-                f"overlapping")
+                f"{int(mask.sum())} of {out.size} elements — set "
+                f"incomplete")
         flat[(_BF16_TAG + key) if bf16 else key] = out
     return flat
 
@@ -271,6 +324,9 @@ def _gc(directory: str, max_to_keep: int):
     files ignored) and only steps strictly older than the newest
     ``max_to_keep`` RESTORABLE steps are ever touched — the coordinated
     cadence means nobody is still writing those. One directory scan."""
+    # (stale-ATTEMPT files at a step still inside the retention window
+    # survive until the step leaves it — bounded by max_to_keep sets and
+    # never restorable, since completeness requires a matching nonce)
     complete, all_shards = _scan_shards(directory)
     mono = set()
     for name in os.listdir(directory):
@@ -363,7 +419,15 @@ def checkpoint_keys(path: str) -> set[str]:
             return set(z.files)
     keys: set[str] = set()
     directory = os.path.dirname(path) or "."
-    for shard in _sharded_steps(directory).get(int(m.group(1)), []):
+    shards = _sharded_steps(directory).get(int(m.group(1)))
+    if not shards:
+        # the set vanished between latest_checkpoint and this read
+        # (racing peer GC): "checkpoint unreadable" must not read as
+        # "no such keys" — callers use the key set to pick a restore
+        # template (ADVICE r4)
+        raise FileNotFoundError(
+            f"sharded checkpoint set for {path!r} is no longer complete")
+    for shard in shards:
         with np.load(shard) as z:
             meta = json.loads(bytes(z[_SHARDMETA]).decode())
             for key, info in meta["leaves"].items():
@@ -486,17 +550,20 @@ class Checkpointer:
         self._last_save = time.time()
         return path
 
-    def save_sharded(self, state, step: int) -> str:
+    def save_sharded(self, state, step: int,
+                     attempt: str | None = None) -> str:
         """This process's shard of a cross-host checkpoint — EVERY
         coordinated process calls this (chief or not); each writes its
         own file, no collective anywhere (see save_checkpoint_sharded).
-        Synchronous: the fetch is 1/P of the model (local shards only),
-        so there is no transfer worth backgrounding. Drains any pending
-        background write on the chief first so the index can't regress."""
+        ``attempt``: the agreed per-save nonce (the coordinator vote /
+        exit agreement carries it). Synchronous: the fetch is 1/P of
+        the model (local shards only), so there is no transfer worth
+        backgrounding. Drains any pending background write on the chief
+        first so the index can't regress."""
         if self.is_chief:
             self._drain()
         path = save_checkpoint_sharded(self.directory, state, step,
-                                       self.max_to_keep)
+                                       self.max_to_keep, attempt=attempt)
         self._last_save = time.time()
         return path
 

@@ -274,12 +274,19 @@ class _PsOptimizer:
             m += 0.1 * g
             v *= 0.999
             v += 0.001 * g * g
-            # scale rounded to f32: the device mirror replays this
-            # update in f32 (x64 is off on the chip), and a float64
-            # scale here would put the two on trajectories a few ulp
-            # apart that the gradient feedback loop then amplifies
-            scale = np.float32(
-                self.lr * np.sqrt(1.0 - 0.999**t) / (1.0 - 0.9**t))
+            # f32 intermediates end to end, matching the device mirror's
+            # chain (train_state.adam: f32 pow/sqrt/divide — x64 is off
+            # on the chip). A float64 chain rounded once at the end can
+            # differ by an ulp for many t (ADVICE r4), and the gradient
+            # feedback loop amplifies that. Note libm's powf and XLA's
+            # pow may still disagree in the last ulp — the parity claim
+            # is "ulp-close, resync-bounded", not bitwise (the resync
+            # cadence re-pulls authoritative params).
+            one = np.float32(1.0)
+            tf_ = np.float32(t)
+            scale = (np.float32(self.lr)
+                     * np.sqrt(one - np.float32(0.999) ** tf_)
+                     / (one - np.float32(0.9) ** tf_))
             param -= scale * m / (np.sqrt(v) + 1e-8)
         else:  # unreachable through __init__'s NAMES gate
             raise ValueError(f"_PsOptimizer cannot apply {self.name!r}")
@@ -873,8 +880,10 @@ class MirrorCycle:
     Params (and, for momentum/adam, optimizer slots + apply counts)
     live ON the chip; each cycle computes grads there, pushes them (the
     ps applies its configured optimizer — ApplyGradientDescent parity
-    generalized, MNISTDist.py:149), and replays the IDENTICAL update on
-    the device mirror — no per-cycle pull and no parameter re-upload,
+    generalized, MNISTDist.py:149), and replays the same update on the
+    device mirror (ulp-close: both sides run f32 chains, but libm and
+    XLA may round pow differently in the last bit; any drift is bounded
+    by the resync cadence) — no per-cycle pull and no parameter re-upload,
     which profiling shows is the dominant cost of the full-pull cycle
     on host-link-bound setups (PERF.md). Slot-carrying optimizers adopt
     the ps's authoritative slots at every resync

@@ -140,9 +140,13 @@ class Supervisor:
     def maybe_checkpoint(self, state, step: int):
         return self.checkpointer.maybe_save(state, step)
 
-    def checkpoint_coordinated(self, state, step: int):
+    def checkpoint_coordinated(self, state, step: int,
+                               attempt: str | None = None):
         """One coordinated checkpoint: EVERY process calls this together
-        (the loop's cadenced vote agreed on the boundary step first).
+        (the loop's cadenced vote agreed on the boundary step first;
+        ``attempt`` is the per-save nonce that vote distributed — the
+        sharded format stamps it so two save attempts at one step can
+        never assemble into a mixed set).
 
         The fetch is the collective half — a state with leaves sharded
         across hosts (a model axis spanning processes) is gathered with
@@ -151,10 +155,10 @@ class Supervisor:
         round-2 latent crash). Only the chief writes the result. Processes
         whose state is locally fetchable and that aren't the chief skip
         the fetch entirely — single-host behavior is unchanged."""
-        self._coordinated_save(state, step, final=False)
+        self._coordinated_save(state, step, final=False, attempt=attempt)
 
     def _coordinated_save(self, state, step: int, *, final: bool,
-                          cancelled=None):
+                          cancelled=None, attempt: str | None = None):
         """The ONE implementation of the symmetric fetch-then-chief-writes
         gate, shared by the cadenced vote path and the managed() exit so
         the two cannot drift apart (a gate that differs between them is a
@@ -184,7 +188,7 @@ class Supervisor:
         )
 
         if self.sharded_spanning and needs_collective_fetch(state):
-            self.checkpointer.save_sharded(state, step)
+            self.checkpointer.save_sharded(state, step, attempt=attempt)
             return
         if self.is_chief:
             flat = flatten_pytree(state, tag_bf16=True)
@@ -289,9 +293,15 @@ class Supervisor:
                 # ADVICE: the unbounded-hang mixed-exit hole).
                 needs = needs_collective_fetch(state_box.state)
                 proceed = True
+                attempt = None
                 if needs:
-                    verdict = agree_clean_exit(
-                        clean_exit, timeout_s=self.exit_agreement_timeout_s)
+                    # the agreement allgather also carries the sharded
+                    # save's attempt nonce — the save itself stays
+                    # collective-free (its load-bearing contract: it
+                    # runs UNBOUNDED below)
+                    verdict, attempt = agree_clean_exit(
+                        clean_exit, timeout_s=self.exit_agreement_timeout_s,
+                        return_token=True)
                     if verdict is None:
                         proceed = False
                         abandoned = ("a peer process never reached the "
@@ -343,7 +353,8 @@ class Supervisor:
                         try:
                             self._coordinated_save(state_box.state,
                                                    state_box.step,
-                                                   final=True)
+                                                   final=True,
+                                                   attempt=attempt)
                         except Exception as e:  # noqa: BLE001 best-effort
                             print(f"final checkpoint failed: {e}")
             self.checkpointer.close()

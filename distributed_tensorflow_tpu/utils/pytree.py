@@ -132,7 +132,8 @@ def run_bounded(fn, timeout_s: float, *, what: str,
     return True, box.get("result")
 
 
-def agree_clean_exit(clean: bool, timeout_s: float = 60.0) -> bool | None:
+def agree_clean_exit(clean: bool, timeout_s: float = 60.0,
+                     return_token: bool = False):
     """All-process agreement gate ahead of a final COLLECTIVE save.
 
     Every process — cleanly exiting or unwinding an exception — joins one
@@ -144,23 +145,40 @@ def agree_clean_exit(clean: bool, timeout_s: float = 60.0) -> bool | None:
     ADVICE failure mode: clean peers blocked forever in process_allgather
     while the raising process skipped it).
 
+    ``return_token=True`` returns ``(verdict, token)`` instead: the same
+    allgather additionally carries a random 8-hex attempt token from
+    process 0 (the sharded checkpoint format's per-attempt nonce,
+    checkpoint.py) — riding THIS bounded agreement keeps the sharded
+    save itself collective-free, its documented contract. ``token`` is
+    None whenever the verdict is not True.
+
     Bounded via ``run_bounded`` (two-stage timeout + grace; see its
     docstring for why the grace closes the asymmetric-abandon window)."""
+    import secrets
+
+    mine = secrets.randbits(31)
 
     def _gather():
         from jax.experimental import multihost_utils
 
-        flags = multihost_utils.process_allgather(
-            np.asarray([1.0 if clean else 0.0], np.float32))
-        return bool(np.all(np.asarray(flags) > 0.5))
+        rows = multihost_utils.process_allgather(
+            np.asarray([1 if clean else 0, mine], np.int32))
+        rows = np.asarray(rows).reshape(-1, 2)
+        return bool(np.all(rows[:, 0] > 0)), int(rows[0, 1])
 
     done, result = run_bounded(_gather, timeout_s, what="exit agreement")
     if not done:
-        return None
-    if isinstance(result, Exception):
+        verdict, token = None, None
+    elif isinstance(result, Exception):
         print(f"exit agreement failed: {result}")
-        return None
-    return result
+        verdict, token = None, None
+    else:
+        verdict, token = result
+    if not verdict:
+        token = None
+    if return_token:
+        return verdict, (format(token, "08x") if token is not None else None)
+    return verdict
 
 
 def fetch_pytree(tree):

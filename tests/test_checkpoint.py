@@ -204,6 +204,16 @@ def test_sharded_checkpoint_roundtrip_mesh_state(tmp_path):
     assert int(restored["host"]) == 7
 
 
+def _shard_file(directory, step, p=0):
+    """The real path of shard ``p`` at ``step`` — saves stamp an attempt
+    nonce into the filename, so tests glob instead of hardcoding."""
+    import glob
+    hits = sorted(glob.glob(os.path.join(str(directory),
+                                         f"ckpt-{step}.shard{p}-of-*.npz")))
+    assert hits, f"no shard {p} at step {step} in {directory}"
+    return hits[0]
+
+
 def test_incomplete_sharded_set_never_restores(tmp_path):
     """A step whose shard set is missing a file (a peer died mid-save)
     must be invisible: latest_checkpoint falls back to the newest
@@ -220,7 +230,7 @@ def test_incomplete_sharded_set_never_restores(tmp_path):
     good = latest_checkpoint(str(tmp_path))
     assert good is not None and good[1] == 5
     # forge an INCOMPLETE 2-shard set at a newer step
-    src = os.path.join(str(tmp_path), "ckpt-5.shard0-of-1.npz")
+    src = _shard_file(tmp_path, 5)
     dst = os.path.join(str(tmp_path), "ckpt-9.shard0-of-2.npz")
     shutil.copy(src, dst)
     found = latest_checkpoint(str(tmp_path))
@@ -240,8 +250,7 @@ def test_sharded_gc_and_inspect(tmp_path):
     for s in (1, 2, 3):
         save_checkpoint_sharded(str(tmp_path), state, step=s, max_to_keep=2)
     assert _all_steps(str(tmp_path)) == [2, 3]
-    rc = describe(os.path.join(str(tmp_path), "ckpt-3.shard0-of-1.npz"),
-                  key="params/w")
+    rc = describe(_shard_file(tmp_path, 3), key="params/w")
     assert rc == 0
 
 
@@ -258,7 +267,7 @@ def test_gc_never_deletes_in_progress_first_save(tmp_path):
 
     # forge "p0 wrote its half of a 2-shard set" from a real 1-shard file
     save_checkpoint_sharded(str(tmp_path), {"w": jnp.arange(4.0)}, step=8)
-    src = os.path.join(str(tmp_path), "ckpt-8.shard0-of-1.npz")
+    src = _shard_file(tmp_path, 8)
     half = os.path.join(str(tmp_path), "ckpt-8.shard0-of-2.npz")
     os.replace(src, half)
     _gc(str(tmp_path), max_to_keep=5)  # p0's GC, no complete set exists
@@ -293,3 +302,178 @@ def test_latest_checkpoint_prefers_newest_across_formats(tmp_path):
     found = latest_checkpoint(str(tmp_path))
     assert found is not None and found[1] == 9
     assert "shard0-of-1" in found[0]
+
+# ---------------------------------------- attempt nonces (ADVICE r4)
+
+def test_mixed_attempt_set_never_assembles(tmp_path):
+    """Shards from two save ATTEMPTS at the same (step, n) — a crashed
+    save then a restart re-reaching the same step — must never combine
+    into a restorable set, even though the (step, n) key matches."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.checkpoint import (
+        latest_checkpoint,
+        save_checkpoint_sharded,
+    )
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        _sharded_steps,
+    )
+
+    save_checkpoint_sharded(str(tmp_path), {"w": jnp.arange(4.0)}, step=3)
+    # forge the halves of TWO different 2-shard attempts at step 9:
+    # attempt aaaaaaaa has shard 0, attempt bbbbbbbb has shard 1
+    src = _shard_file(tmp_path, 3)
+    shutil.copy(src, os.path.join(
+        str(tmp_path), "ckpt-9.shard0-of-2.aaaaaaaa.npz"))
+    shutil.copy(src, os.path.join(
+        str(tmp_path), "ckpt-9.shard1-of-2.bbbbbbbb.npz"))
+    assert 9 not in _sharded_steps(str(tmp_path))
+    found = latest_checkpoint(str(tmp_path))
+    assert found is not None and found[1] == 3, found
+
+
+def test_two_complete_attempts_newest_wins(tmp_path):
+    """When a step somehow holds two COMPLETE sets (re-save after a
+    restore, both attempts finished), the most recently written attempt
+    is the one restored — never a mix."""
+    import jax.numpy as jnp
+    import time as _time
+
+    from distributed_tensorflow_tpu.checkpoint import save_checkpoint_sharded
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        load_flat_sharded,
+    )
+
+    save_checkpoint_sharded(str(tmp_path), {"w": jnp.zeros(4)}, step=5,
+                            attempt="aaaaaaaa")
+    _time.sleep(0.05)  # distinct mtimes
+    save_checkpoint_sharded(str(tmp_path), {"w": jnp.ones(4)}, step=5,
+                            attempt="bbbbbbbb")
+    flat = load_flat_sharded(str(tmp_path), 5)
+    np.testing.assert_array_equal(flat["w"], np.ones(4, np.float32))
+
+
+def test_explicit_attempt_lands_in_filename(tmp_path):
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.checkpoint import save_checkpoint_sharded
+
+    path = save_checkpoint_sharded(str(tmp_path), {"w": jnp.arange(2.0)},
+                                   step=1, attempt="deadbeef")
+    assert path.endswith("ckpt-1.shard0-of-1.deadbeef.npz")
+    assert os.path.exists(path)
+
+
+def test_nonceless_legacy_shards_still_restore(tmp_path):
+    """Pre-nonce shard files (no attempt suffix) remain a complete,
+    restorable set — the format change is backward compatible."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.checkpoint import (
+        restore_latest,
+        save_checkpoint_sharded,
+    )
+
+    real = save_checkpoint_sharded(str(tmp_path), {"w": jnp.arange(4.0)},
+                                   step=2)
+    legacy = os.path.join(str(tmp_path), "ckpt-2.shard0-of-1.npz")
+    os.replace(real, legacy)
+    out = restore_latest(str(tmp_path), {"w": np.zeros(4, np.float32)})
+    assert out is not None and out[1] == 2
+    np.testing.assert_array_equal(out[0]["w"], np.arange(4.0, dtype=np.float32))
+
+
+def test_overlapping_entries_rejected(tmp_path):
+    """load_flat_sharded's coverage check is positional (ADVICE r4): an
+    overlap plus a gap that sums to the right element count must fail."""
+    import json as _json
+
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.checkpoint import save_checkpoint_sharded
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        _SHARDMETA,
+        load_flat_sharded,
+    )
+
+    path = save_checkpoint_sharded(str(tmp_path), {"w": jnp.arange(4.0)},
+                                   step=1, attempt="cafecafe")
+    with np.load(path) as z:
+        meta = _json.loads(bytes(z[_SHARDMETA]).decode())
+        arrays = {k: z[k] for k in z.files if k != _SHARDMETA}
+    # duplicate the sole entry, then shrink both to half the leaf: two
+    # overlapping [0:2] slices cover 4 elements total but leave [2:4]
+    # as a gap — the old element-count check passed this
+    (e,) = meta["leaves"]["w"]["entries"]
+    e2 = dict(e, npz="w@1")
+    e["index"] = [[0, 2]]
+    e2["index"] = [[0, 2]]
+    meta["leaves"]["w"]["entries"] = [e, e2]
+    arrays["w@1"] = arrays[e["npz"]][:2].copy()
+    arrays[e["npz"]] = arrays[e["npz"]][:2].copy()
+    arrays[_SHARDMETA] = np.frombuffer(
+        _json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(path, **arrays)
+    with pytest.raises(ValueError, match="overlap"):
+        load_flat_sharded(str(tmp_path), 1)
+
+
+def test_checkpoint_keys_raises_on_vanished_set(tmp_path):
+    """A shard path whose set disappeared (racing peer GC) must raise,
+    not return an empty key set that flips template decisions
+    (ADVICE r4)."""
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        checkpoint_keys,
+    )
+
+    ghost = os.path.join(str(tmp_path), "ckpt-4.shard0-of-2.abcdabcd.npz")
+    with pytest.raises(FileNotFoundError):
+        checkpoint_keys(ghost)
+
+
+def test_invalid_attempt_token_rejected(tmp_path):
+    """A token the scan regex can't parse would be silently unrestorable
+    AND invisible to GC — the save must refuse it up front."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.checkpoint import save_checkpoint_sharded
+
+    for bad in ("ABCD1234", "xyz", "deadbeef0", "dead-bee"):
+        with pytest.raises(ValueError, match="8 lowercase hex"):
+            save_checkpoint_sharded(str(tmp_path), {"w": jnp.zeros(2)},
+                                    step=1, attempt=bad)
+
+
+def test_default_attempt_is_collective_free_and_single_process_noncing(
+        tmp_path):
+    """attempt=None single-process: a fresh valid nonce per save (no
+    collective exists to agree one — and none must: the supervisor exit
+    path runs the sharded save unbounded)."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.checkpoint import save_checkpoint_sharded
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import _SHARD_RE
+
+    p1 = save_checkpoint_sharded(str(tmp_path), {"w": jnp.zeros(2)}, step=1)
+    p2 = save_checkpoint_sharded(str(tmp_path), {"w": jnp.zeros(2)}, step=2)
+    m1 = _SHARD_RE.fullmatch(os.path.basename(p1))
+    m2 = _SHARD_RE.fullmatch(os.path.basename(p2))
+    assert m1 and m2 and m1.group(4) and m2.group(4)
+    assert m1.group(4) != m2.group(4)
+
+
+def test_exit_agreement_carries_attempt_token():
+    """agree_clean_exit(return_token=True): verdict True comes with an
+    8-hex token (single-process: process 0's own draw); a failed verdict
+    carries None."""
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import _ATTEMPT_RE
+    from distributed_tensorflow_tpu.utils.pytree import agree_clean_exit
+
+    verdict, token = agree_clean_exit(True, timeout_s=30.0,
+                                      return_token=True)
+    assert verdict is True and _ATTEMPT_RE.fullmatch(token)
+    verdict, token = agree_clean_exit(False, timeout_s=30.0,
+                                      return_token=True)
+    assert verdict is False and token is None
+    # the 1-arg form is unchanged for existing callers
+    assert agree_clean_exit(True, timeout_s=30.0) is True
