@@ -264,6 +264,12 @@ def define_reference_flags():
                    "the dense O(S^2) score matrix; the one-chip "
                    "long-context path). lm model only; mutually "
                    "exclusive with --seq_parallel's ring attention")
+    DEFINE_integer("ce_block", 0, "If > 0, the LM loss head streams "
+                   "over row blocks of this many tokens (custom-VJP "
+                   "softmax-CE — the (B,S,V) f32 logits never "
+                   "materialize; O(block*V) peak both passes). The "
+                   "large-vocab half of the long-context memory story; "
+                   "lm model only")
     DEFINE_boolean("remat", False, "Rematerialize each transformer block "
                    "in the backward pass (jax.checkpoint): activation "
                    "memory drops to one block's worth at the cost of "

@@ -220,6 +220,15 @@ class TransformerLM:
     ``remat=True`` wraps each block in ``jax.checkpoint`` — activation
     memory drops from O(num_blocks * S * d) to O(S * d) + one block's
     recompute, the standard trade for long sequences.
+
+    ``ce_block=N`` streams the LOSS head the same way ``attn_block``
+    streams attention: the train/eval steps route through
+    ``loss_with_metrics`` (ops.nn.streamed_softmax_ce_head), which
+    never materializes the (B, S, V) f32 logits — O(N * V) peak in
+    both passes. The other memory wall of large-vocab long context
+    (the flash VJPs removed the O(S^2) one). ``apply`` still exists
+    and still returns full logits (generation/inspection); training
+    simply never calls it when ``ce_block`` is set.
     """
 
     stateful = False
@@ -236,6 +245,7 @@ class TransformerLM:
         seq_axis: str | None = None,
         attn_block: int | None = None,
         remat: bool = False,
+        ce_block: int | None = None,
         **_unused,
     ):
         if d_model % num_heads:
@@ -254,6 +264,7 @@ class TransformerLM:
         self.seq_axis = seq_axis
         self.attn_block = attn_block
         self.remat = remat
+        self.ce_block = ce_block
 
     def init(self, key, dtype=jnp.float32):
         d, h = self.d_model, self.num_heads
@@ -278,7 +289,12 @@ class TransformerLM:
                 _block_params(w, d, h, dh, self.mlp_dim, dtype))
         return params
 
-    def apply(self, params, x, *, keep_prob=1.0, rng=None, train: bool = False):
+    def apply_hidden(self, params, x, *, keep_prob=1.0, rng=None,
+                     train: bool = False):
+        """Everything up to (but not including) the vocab head: final
+        hidden states (B, S, d) after ln_f + dropout. The streamed-CE
+        path consumes this directly so the (B, S, V) logits never
+        materialize; ``apply`` adds the head on top."""
         cd = self.compute_dtype
         # x: integer ids (B, S) — or the LOCAL token block (B, S/P) when
         # called inside the SP shard_map step
@@ -314,10 +330,27 @@ class TransformerLM:
             # shards (each shard holds DIFFERENT tokens — unlike the
             # classifier's post-pool dropout, which must be identical)
             rng = jax.random.fold_in(rng, lax.axis_index(self.seq_axis))
-        h = nn.dropout(h, keep_prob, rng, deterministic=not train)
+        return nn.dropout(h, keep_prob, rng, deterministic=not train)
+
+    def apply(self, params, x, *, keep_prob=1.0, rng=None, train: bool = False):
+        h = self.apply_hidden(params, x, keep_prob=keep_prob, rng=rng,
+                              train=train)
         logits = nn.dense(h, params["head"]["w"], params["head"]["b"],
-                          compute_dtype=cd)
+                          compute_dtype=self.compute_dtype)
         return logits.astype(jnp.float32)
+
+    def loss_with_metrics(self, params, x, y, *, keep_prob=1.0, rng=None,
+                          train: bool = False):
+        """(loss, {"loss", "accuracy"}) via the streamed head — the
+        train/eval hook ``training.loss_and_metrics`` routes through
+        when ``ce_block`` is set. Values/grads match apply +
+        softmax_cross_entropy to fp tolerance (tests/test_lm.py)."""
+        h = self.apply_hidden(params, x, keep_prob=keep_prob, rng=rng,
+                              train=train)
+        loss, acc = nn.streamed_softmax_ce_head(
+            h, params["head"]["w"], params["head"]["b"], y,
+            block=self.ce_block, compute_dtype=self.compute_dtype)
+        return loss, {"loss": loss, "accuracy": acc}
 
     def num_params(self, params=None):
         if params is None:

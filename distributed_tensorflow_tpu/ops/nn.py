@@ -139,6 +139,154 @@ def softmax_cross_entropy(logits, labels):
     return jnp.mean(per_example)
 
 
+def _head_logits(h_blk, w, b, cd):
+    """One row-block's logits, numerically IDENTICAL to the unstreamed
+    head: ``dense(h, w, b, compute_dtype=cd).astype(f32)`` (the LM head,
+    models/transformer.py) — dot in ``cd``, cast back to h's dtype, bias
+    in that dtype, then the f32 cast the loss sees."""
+    if cd is not None:
+        y = jnp.dot(h_blk.astype(cd), w.astype(cd)).astype(h_blk.dtype)
+    else:
+        y = jnp.dot(h_blk, w)
+    y = y + b.astype(y.dtype)
+    return y.astype(jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _streamed_ce(h2, w, b, labels2, block, cd, n_valid):
+    return _streamed_ce_forward(h2, w, b, labels2, block, cd, n_valid)[0]
+
+
+def _streamed_ce_forward(h2, w, b, labels2, block, cd, n_valid):
+    """Forward scan over row blocks; returns ((loss, acc), lse (N,))."""
+    n_pad, d = h2.shape
+    nb = n_pad // block
+    hb = h2.reshape(nb, block, d)
+    lb = labels2.reshape(nb, block)
+    valid = (jnp.arange(n_pad) < n_valid).reshape(nb, block)
+
+    def step(carry, inp):
+        h_blk, lbl, vmask = inp
+        logits = _head_logits(h_blk, w, b, cd)  # (R, V) f32 — the peak
+        m = jnp.max(logits, axis=-1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+        onehot = jax.nn.one_hot(lbl, logits.shape[-1], dtype=logits.dtype)
+        # where(), not onehot*logits — same -inf rationale as
+        # softmax_cross_entropy above
+        lab = jnp.sum(jnp.where(onehot != 0, logits, 0.0), axis=-1)
+        vf = vmask.astype(jnp.float32)
+        # out-of-range ids: zero loss AND zero gradient, matching
+        # softmax_cross_entropy's one_hot semantics (all-zero row);
+        # accuracy still counts the row in its denominator (a miss) —
+        # exactly what argmax == out-of-range-id yields
+        ok = ((lbl >= 0) & (lbl < logits.shape[-1])).astype(jnp.float32)
+        loss_sum, corr_sum = carry
+        loss_sum = loss_sum + jnp.sum((lse - lab) * vf * ok)
+        hit = (jnp.argmax(logits, axis=-1) == lbl).astype(jnp.float32)
+        corr_sum = corr_sum + jnp.sum(hit * vf)
+        return (loss_sum, corr_sum), lse
+
+    (loss_sum, corr_sum), lses = lax.scan(
+        step, (jnp.float32(0.0), jnp.float32(0.0)), (hb, lb, valid))
+    inv = jnp.float32(1.0 / n_valid)
+    return (loss_sum * inv, corr_sum * inv), lses
+
+
+def _streamed_ce_fwd(h2, w, b, labels2, block, cd, n_valid):
+    out, lses = _streamed_ce_forward(h2, w, b, labels2, block, cd, n_valid)
+    return out, (h2, w, b, labels2, lses)
+
+
+def _streamed_ce_bwd(block, cd, n_valid, res, ct):
+    """The streamed backward: recompute each block's logits from
+    (h, w, b) and its saved row logsumexps — dL/dlogits = softmax -
+    onehot, never materialized beyond one (block, V) panel. dw/db
+    accumulate in f32 across the scan; dh blocks stack. The accuracy
+    output's cotangent is ignored (argmax has no gradient)."""
+    h2, w, b, labels2, lses = res
+    g_loss = ct[0]
+    n_pad, d = h2.shape
+    nb = n_pad // block
+    hb = h2.reshape(nb, block, d)
+    lb = labels2.reshape(nb, block)
+    valid = (jnp.arange(n_pad) < n_valid).reshape(nb, block)
+    lsb = lses.reshape(nb, block)
+    scale = g_loss.astype(jnp.float32) / n_valid
+
+    def step(carry, inp):
+        dw, db = carry
+        h_blk, lbl, vmask, lse_blk = inp
+        logits = _head_logits(h_blk, w, b, cd)
+        p = jnp.exp(logits - lse_blk[:, None])
+        onehot = jax.nn.one_hot(lbl, logits.shape[-1], dtype=jnp.float32)
+        ok = ((lbl >= 0) & (lbl < logits.shape[-1])).astype(jnp.float32)
+        g = (p - onehot) * (vmask.astype(jnp.float32) * ok
+                            * scale)[:, None]
+        if cd is not None:
+            gc = g.astype(cd)
+            dh_blk = jnp.dot(gc, w.astype(cd).T).astype(h2.dtype)
+            dw = dw + jnp.dot(h_blk.astype(cd).T, gc).astype(jnp.float32)
+        else:
+            dh_blk = jnp.dot(g, w.T).astype(h2.dtype)
+            dw = dw + jnp.dot(h_blk.T, g)
+        db = db + jnp.sum(g, axis=0)
+        return (dw, db), dh_blk
+
+    dw0 = jnp.zeros(w.shape, jnp.float32)
+    db0 = jnp.zeros(b.shape, jnp.float32)
+    (dw, db), dhb = lax.scan(step, (dw0, db0), (hb, lb, valid, lsb))
+    import numpy as np
+
+    from jax.dtypes import float0
+
+    return (dhb.reshape(n_pad, d), dw.astype(w.dtype), db.astype(b.dtype),
+            np.zeros(labels2.shape, float0))
+
+
+_streamed_ce.defvjp(_streamed_ce_fwd, _streamed_ce_bwd)
+
+
+def streamed_softmax_ce_head(h, w, b, labels, block: int,
+                             compute_dtype=None):
+    """Fused dense head + softmax-CE + accuracy, streamed over row
+    blocks: the vocab-axis flash (the round-4 lesson applied to the
+    loss). The unstreamed LM head materializes (B, S, V) f32 logits
+    PLUS their gradient — at the vocab sizes that make an LM real
+    (8k-50k) that dwarfs what the flash attention backward saved. Here
+    the logits never exist beyond one (block, V) f32 panel: a
+    ``lax.scan`` over row blocks computes each block's logits, its
+    rows' logsumexp + label logit + argmax hit (forward), and a custom
+    VJP recomputes the block's softmax from the saved per-row
+    logsumexps in the backward — O(block * V) peak in BOTH passes,
+    same recurrence discipline as ops/attention.py's flash backward.
+
+    ``h``: (..., d) hidden states (any leading shape — (B, S) for the
+    LM); ``labels``: integer ids of h's leading shape; ``w``/(``b``):
+    the head projection. Values and gradients match
+    ``softmax_cross_entropy(dense(h, w, b, compute_dtype), labels)``
+    + ``accuracy`` to fp tolerance (pinned by tests/test_lm.py).
+    Returns (mean loss f32, accuracy f32).
+    """
+    d = h.shape[-1]
+    n_valid = 1
+    for s in h.shape[:-1]:
+        n_valid *= int(s)
+    if labels.shape != h.shape[:-1]:
+        raise ValueError(f"labels shape {labels.shape} != hidden leading "
+                         f"shape {h.shape[:-1]}")
+    h2 = h.reshape(n_valid, d)
+    labels2 = labels.reshape(n_valid)
+    pad = (-n_valid) % int(block)
+    if pad:
+        # zero rows, label 0, masked out by n_valid inside the op; the
+        # concat/slice transpose drops their gradient automatically
+        h2 = jnp.concatenate([h2, jnp.zeros((pad, d), h2.dtype)])
+        labels2 = jnp.concatenate(
+            [labels2, jnp.zeros((pad,), labels2.dtype)])
+    return _streamed_ce(h2, w, b, labels2, int(block), compute_dtype,
+                        n_valid)
+
+
 def accuracy(logits, labels):
     """Minibatch argmax-equality accuracy (reference, MNISTDist.py:152-153).
     ``labels``: one-hot [B, C] or integer class ids [B]."""

@@ -29,7 +29,12 @@ import jax.numpy as jnp
 
 def run_config(seq_len: int, variant: str, batch: int = 8,
                d_model: int = 256, num_heads: int = 4,
-               num_blocks: int = 4, steps: int = 10) -> dict:
+               num_blocks: int = 4, steps: int = 10,
+               vocab_size: int = 64, attn_block_size: int = 512) -> dict:
+    """``variant`` tokens: "dense"/"block" (attention form), "+remat",
+    "+ce" (streamed loss head, ce_block=attn_block_size — the
+    vocab-axis flash; without it the head materializes (B, S, V) f32
+    logits + grads)."""
     from distributed_tensorflow_tpu.data.lm import LMDataSet
     from distributed_tensorflow_tpu.models.transformer import TransformerLM
     from distributed_tensorflow_tpu.training import (
@@ -38,19 +43,24 @@ def run_config(seq_len: int, variant: str, batch: int = 8,
         make_train_step,
     )
 
-    attn_block = 512 if "block" in variant else None
+    attn_block = attn_block_size if "block" in variant else None
     remat = "remat" in variant
+    ce_block = attn_block_size if "ce" in variant else None
     rec = {"seq_len": seq_len, "variant": variant, "batch": batch,
-           "d_model": d_model, "num_blocks": num_blocks}
-    model = TransformerLM(vocab_size=64, seq_len=seq_len, d_model=d_model,
-                          num_heads=num_heads, num_blocks=num_blocks,
+           "d_model": d_model, "num_blocks": num_blocks,
+           "vocab_size": vocab_size}
+    model = TransformerLM(vocab_size=vocab_size, seq_len=seq_len,
+                          d_model=d_model, num_heads=num_heads,
+                          num_blocks=num_blocks,
                           attn_block=attn_block, remat=remat,
+                          ce_block=ce_block,
                           compute_dtype=jnp.bfloat16)
     opt = get_optimizer("adam", 1e-3)
     step = make_train_step(model, opt, keep_prob=1.0)
     try:
         state = create_train_state(model, opt, seed=0)
-        ds = LMDataSet(max(batch, 8), seq_len=seq_len, vocab_size=64, seed=0)
+        ds = LMDataSet(max(batch, 8), seq_len=seq_len,
+                       vocab_size=vocab_size, seed=0)
         b = ds.next_batch(batch)
         lowered = step.lower(state, b)
         compiled = lowered.compile()
@@ -88,6 +98,20 @@ def run_config(seq_len: int, variant: str, batch: int = 8,
 
 def main():
     quick = "--quick" in sys.argv
+    vocab = "--vocab" in sys.argv
+    if vocab:
+        # the vocab axis (r5): at real vocab sizes the UNSTREAMED loss
+        # head's (B, S, V) f32 logits+grads dwarf what the flash
+        # attention backward saved; "+ce" streams them (ce_block).
+        # Expect: naive head OOMs/compile-fails where block+ce runs.
+        for v_size in (8192, 32768):
+            for s in (4096, 8192, 16384):
+                for var in ("block", "block+ce"):
+                    # the naive head hitting its wall IS a datapoint —
+                    # no skip for the "block" (unstreamed-loss) rows
+                    print(json.dumps(run_config(s, var, vocab_size=v_size)),
+                          flush=True)
+        return
     lengths = [512, 2048, 4096] if quick else [512, 1024, 2048, 4096, 8192,
                                                16384]
     variants = ["dense", "dense+remat", "block", "block+remat"]
