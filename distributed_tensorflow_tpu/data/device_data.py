@@ -36,6 +36,52 @@ class DeviceData(NamedTuple):
         return self.labels.shape[0]
 
 
+def put_device_data_sp(split, mesh, per_token_targets: bool,
+                       token_shape: tuple[int, int] | None = None
+                       ) -> DeviceData:
+    """Stage a split for the SEQUENCE-PARALLEL resident sampler: inputs
+    sharded over the mesh's token ("model") axis, replicated over the
+    data axis — each device holds (N, S/P[, token]) of the whole split,
+    and the in-program gather draws the SAME example rows on every
+    token shard of a data row (training/device_step's SP body), so a
+    sampled batch IS the (B, S/P) tile ``stage_batch_sp`` would have
+    uploaded. Token splits (``per_token_targets``): targets tiled like
+    the inputs (next-token targets live with the tokens they score);
+    image splits: inputs reshaped to (N, S, token_dim) host-side first
+    (sequence_parallel.reshape_for_sp), labels replicated. Storage
+    keeps the thin-wire dtypes (u8/u16 tokens, u8 pixels) — HBM cost
+    is the split, tiny next to long-context activations."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
+
+    x, y = split.images, split.labels
+    if per_token_targets:
+        # LM split: keep the native storage dtype (images/labels
+        # materialize int32 copies of the whole split)
+        toks = getattr(split, "_tokens", None)
+        if toks is not None:
+            x, y = toks[:, :-1], toks[:, 1:]
+        x_spec, y_spec = P(None, MODEL_AXIS), P(None, MODEL_AXIS)
+    else:
+        if token_shape is None:
+            raise ValueError("image splits need token_shape=(seq_len, "
+                             "token_dim) to expose a token axis to shard")
+        s, td = token_shape
+        x = np.asarray(split._raw_u8()).reshape(-1, s, td)
+        y = split.labels_int.astype(np.int32)
+        x_spec, y_spec = P(None, MODEL_AXIS), P(None)
+    arrays, specs = (np.asarray(x), np.asarray(y)), (x_spec, y_spec)
+    out = []
+    for arr, spec in zip(arrays, specs):
+        sh = NamedSharding(mesh, spec)
+        if jax.process_count() > 1:
+            out.append(jax.make_array_from_process_local_data(sh, arr))
+        else:
+            out.append(jax.device_put(jnp.asarray(arr), sh))
+    return DeviceData(*out)
+
+
 def put_device_data(split, mesh=None) -> DeviceData:
     """Stage a host ``DataSet`` split into HBM.
 
@@ -46,9 +92,18 @@ def put_device_data(split, mesh=None) -> DeviceData:
     already holds the full split (``MNISTDist.py:167`` semantics), so each
     supplies its own copy to the global replicated array — each host
     uploads only to its own chips.
+
+    Token splits (LMDataSet) stage too: inputs/targets keep their u8/u16
+    storage ((N, S) each — the x/y views of one (N, S+1) token table),
+    and the sampled-gather step feeds them to the LM unchanged (ids are
+    the thin-wire format; data/lm.py:121).
     """
-    x = split._raw_u8()
-    y = split.labels_int.astype(np.int32)
+    toks = getattr(split, "_tokens", None)
+    if toks is not None:
+        x, y = toks[:, :-1], toks[:, 1:]
+    else:
+        x = split._raw_u8()
+        y = split.labels_int.astype(np.int32)
     if mesh is not None:
         from distributed_tensorflow_tpu.parallel.mesh import replicated_sharding
 

@@ -19,6 +19,7 @@ documented on the ``--device_data`` flag.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -127,6 +128,84 @@ def make_device_dp_train_step(model, optimizer, mesh, batch_size: int, *,
         _scan_chunk(body, chunk),
         mesh=mesh,
         in_specs=(P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def make_device_sp_train_step(sp_model, optimizer, mesh, batch_size: int, *,
+                              keep_prob: float = 1.0, chunk: int = 1,
+                              donate: bool = True, grad_transform=None,
+                              per_token_targets: bool = True):
+    """Sequence-parallel chunked step over device-resident data — the
+    composition of the two beyond-parity modes (--device_data +
+    --seq_parallel). The split lives sharded over the token ("model")
+    axis (data/device_data.put_device_data_sp); inside ``shard_map``
+    each device samples example rows with a key folded on the DATA axis
+    index ONLY — every token shard of a data row draws the SAME rows,
+    so its local gather yields exactly its (B_local, S/P) tile of the
+    batch, no collective on the input side. The rest is the SP train
+    step verbatim: per-shard grads, ONE uniform pmean over the sequence
+    axis then the data axis (both loss-family derivations in
+    parallel/sequence_parallel.py), identical update everywhere.
+    ``sp_model`` must carry ``seq_axis=MODEL_AXIS``."""
+    from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
+    from distributed_tensorflow_tpu.training.train_state import compute_grads
+
+    if getattr(sp_model, "seq_axis", None) != MODEL_AXIS:
+        raise ValueError(
+            f"sp_model.seq_axis must be {MODEL_AXIS!r} (got "
+            f"{getattr(sp_model, 'seq_axis', None)!r})")
+    n_data = mesh.shape[DATA_AXIS]
+    if batch_size % n_data:
+        raise ValueError(
+            f"batch_size={batch_size} not divisible by the {n_data}-way "
+            f"data axis")
+    local_batch = batch_size // n_data
+
+    def body(state: TrainState, data):
+        rng, sub = jax.random.split(state.rng)
+        samp = jax.random.fold_in(state.rng, _SAMPLE_SALT)
+        # DATA-axis fold only: token shards of one data row must draw
+        # identical example rows (their tiles are slices of the same
+        # sequences). The dropout key matches make_sp_train_step's: per
+        # data shard here, and the LM folds the sequence index itself.
+        samp = jax.random.fold_in(samp, lax.axis_index(DATA_AXIS))
+        sub = jax.random.fold_in(sub, lax.axis_index(DATA_AXIS))
+        idx = jax.random.randint(samp, (local_batch,), 0,
+                                 data.num_examples)
+        x = data.images[idx]
+        y = data.labels[idx]
+        if per_token_targets:
+            # u8/u16 token storage -> int32 ids (image splits keep u8:
+            # normalize_if_u8 in the model needs the original dtype)
+            x = x.astype(jnp.int32)
+            y = y.astype(jnp.int32)
+        grads, metrics, model_state = compute_grads(
+            sp_model, state.params, (x, y), keep_prob=keep_prob, rng=sub,
+            model_state=state.model_state)
+        grads = lax.pmean(grads, MODEL_AXIS)
+        grads = lax.pmean(grads, DATA_AXIS)
+        if grad_transform is not None:
+            grads = grad_transform(grads)
+        metrics = lax.pmean(metrics, MODEL_AXIS)
+        metrics = lax.pmean(metrics, DATA_AXIS)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params, state.step)
+        params = apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1, rng,
+                          model_state), metrics
+
+    from distributed_tensorflow_tpu.data.device_data import DeviceData
+
+    y_spec = P(None, MODEL_AXIS) if per_token_targets else P(None)
+    fn = jax.shard_map(
+        _scan_chunk(body, chunk),
+        mesh=mesh,
+        # the data spec mirrors DeviceData's pytree type (shard_map's
+        # spec matching is structural, a bare tuple prefix won't do)
+        in_specs=(P(), DeviceData(P(None, MODEL_AXIS), y_spec)),
         out_specs=(P(), P()),
         check_vma=False,
     )

@@ -76,6 +76,7 @@ def build_model_for(FLAGS, meta: dict):
                 f"--dataset lm produces token sequences; --model "
                 f"{FLAGS.model!r} is an image model. Use --model lm.")
         attn_block = int(getattr(FLAGS, "attn_block", 0))
+        ce_block = int(getattr(FLAGS, "ce_block", 0))
         return get_model(
             "lm",
             vocab_size=meta["vocab_size"],
@@ -86,6 +87,7 @@ def build_model_for(FLAGS, meta: dict):
             compute_dtype=compute_dtype,
             attn_block=attn_block if attn_block > 0 else None,
             remat=bool(getattr(FLAGS, "remat", False)),
+            ce_block=ce_block if ce_block > 0 else None,
         )
     if FLAGS.model == "lm":
         raise ValueError("--model lm consumes token sequences; use "
@@ -190,6 +192,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 f"--batch_size={FLAGS.batch_size} must be divisible by "
                 f"--accum_steps={accum}"
             )
+    sp_device_model = None  # set by the SP branch for --device_data
     if getattr(FLAGS, "seq_parallel", False):
         # sequence/context parallelism: tokens sharded --model_axis ways,
         # ring attention over the mesh's "model" axis
@@ -237,23 +240,23 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 "--attn_block (local blockwise attention) and "
                 "--seq_parallel (ring attention) are mutually exclusive "
                 "attention flavors — the SP step ring-attends; drop one")
-        # the two flags SP genuinely cannot compose with (each justified
-        # in its error text); --accum_steps and --clip_norm DO compose —
-        # they are pre-reduction/post-reduction gradient transforms with
-        # no SP interaction (make_sp_train_step wires them like DP's)
-        for flag, why in (
-            ("device_data", "the resident sampler stages flat (images, "
-                            "labels) splits and draws (B, F) batches "
-                            "in-program — it has no (B, S, token) tiling "
-                            "to hand the token axis, and rewriting its "
-                            "on-device gather to emit SP tiles is the "
-                            "open item, not a flag toggle"),
-            ("augment", "augmentation crops/flips the image layout; "
-                        "token blocks have no spatial structure"),
-        ):
-            if getattr(FLAGS, flag, False):
-                raise ValueError(f"--{flag} is not supported with "
-                                 f"--seq_parallel ({why})")
+        # the one flag SP genuinely cannot compose with (--device_data
+        # composes as of r5: the resident split shards over the token
+        # axis and every token shard of a data row draws the same
+        # example rows — device_step.make_device_sp_train_step);
+        # --accum_steps and --clip_norm compose as pre/post-reduction
+        # gradient transforms with no SP interaction
+        if getattr(FLAGS, "augment", False):
+            raise ValueError(
+                "--augment is not supported with --seq_parallel "
+                "(augmentation crops/flips the image layout; token "
+                "blocks have no spatial structure)")
+        if getattr(FLAGS, "device_data", False) and span and n_procs > 1:
+            raise ValueError(
+                "--device_data with --sp_span_hosts is not supported: "
+                "the resident split would need per-process token-axis "
+                "tiles of every example; stage batches instead (the "
+                "span-host stager uploads only each process's tile)")
 
         if is_lm:
             if model.seq_len >= 1024:
@@ -346,6 +349,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             stage = lambda b: stage_impl(
                 (reshape_for_sp(sp_model, b[0]), b[1]))
         restage = lambda s: replicate_state(mesh, s)
+        sp_device_model = sp_model
         if n_procs == 1:
             # periodic + final full-split evals run THROUGH the sharded
             # eval step on the live mesh state (the dense twin only
@@ -429,12 +433,6 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
 
     use_device_data = bool(getattr(FLAGS, "device_data", False))
     if use_device_data:
-        if is_lm:
-            raise ValueError(
-                "--device_data is not wired for --dataset lm yet: the "
-                "resident sampler stages (images, labels) splits; token "
-                "sequences feed through the host pipeline (whose per-"
-                "step bytes are tiny — S int32 tokens per example)")
         if jax.process_count() > 1 and mesh is None:
             raise ValueError(
                 "--device_data under multi-process requires sync mode "
@@ -442,8 +440,9 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             )
         return _train_device_resident(
             FLAGS, ds, model, opt, state, mesh, n_chips, eval_fn, stage, clip,
-            tp=(mode == "sync" and model_axis > 1), restage=restage,
-            augment_fn=augment)
+            tp=(mode == "sync" and model_axis > 1 and sp_device_model is None),
+            restage=restage, augment_fn=augment,
+            sp_model=sp_device_model, per_token_targets=is_lm)
 
     sv = Supervisor(
         is_chief=(FLAGS.task_index == 0),
@@ -837,41 +836,61 @@ class _HostCoordinator:
 
     def tick(self, state, step: int) -> None:
         """Call once per loop iteration, after ``step`` advanced. At each
-        boundary: one allgather of [stop?, chief-save-due?]; any stop vote
-        stops everyone, a save vote routes every process into the
-        coordinated checkpoint."""
+        boundary: one allgather of [stop?, chief-save-due?, token]; any
+        stop vote stops everyone, a save vote routes every process into
+        the coordinated checkpoint. The token column (random per
+        process, row 0's wins) is the sharded checkpoint's per-attempt
+        nonce — agreed HERE so the save itself stays collective-free."""
+        import secrets
+
         boundary = step // self._every
         if boundary == self._boundary:
             return
         self._boundary = boundary
         votes = self._allgather(self._np.asarray(
-            [self._sv.should_stop(), self._sv.checkpointer.cadence_due()],
+            [self._sv.should_stop(), self._sv.checkpointer.cadence_due(),
+             secrets.randbits(31)],
             self._np.int32))
-        votes = votes.reshape(-1, 2)
+        votes = votes.reshape(-1, 3)
         if votes[:, 1].max():
-            self._sv.checkpoint_coordinated(state, step)
+            self._sv.checkpoint_coordinated(
+                state, step, attempt=format(int(votes[0, 2]), "08x"))
         self._stop = bool(votes[:, 0].max())
 
 
 def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                            eval_fn, stage, grad_transform=None,
                            tp: bool = False, restage=None,
-                           augment_fn=None) -> TrainResult:
+                           augment_fn=None, sp_model=None,
+                           per_token_targets: bool = False) -> TrainResult:
     """--device_data training: the split resident in HBM, batches sampled on
     device, ``lax.scan`` chunks amortizing dispatch (training/device_step).
     Per training step NOTHING crosses the host boundary; per display step
     one host batch is staged for the reference-semantics eval print
-    (dropout-off, before-the-update — ``MNISTDist.py:179-182``)."""
+    (dropout-off, before-the-update — ``MNISTDist.py:179-182``).
+    ``sp_model`` (seq_axis twin) routes the sequence-parallel composition:
+    the split stages token-axis-sharded and the chunked step samples
+    inside shard_map (device_step.make_device_sp_train_step)."""
     import math
 
-    from distributed_tensorflow_tpu.data.device_data import put_device_data
+    from distributed_tensorflow_tpu.data.device_data import (
+        put_device_data,
+        put_device_data_sp,
+    )
     from distributed_tensorflow_tpu.training.device_step import (
         make_device_dp_train_step,
+        make_device_sp_train_step,
         make_device_tp_train_step,
         make_device_train_step,
     )
 
-    data = put_device_data(ds.train, mesh)
+    if sp_model is not None:
+        token_shape = (None if per_token_targets
+                       else (sp_model.seq_len, sp_model.token_dim))
+        data = put_device_data_sp(ds.train, mesh, per_token_targets,
+                                  token_shape=token_shape)
+    else:
+        data = put_device_data(ds.train, mesh)
     chunk = max(1, math.gcd(FLAGS.display_step, max(1, FLAGS.device_chunk)))
     if chunk != FLAGS.device_chunk:
         print(f"--device_chunk={FLAGS.device_chunk} clamped to {chunk} so "
@@ -879,6 +898,12 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
               f"boundaries (dispatch amortization shrinks accordingly)")
 
     def build_chunk_fn(length: int):
+        if sp_model is not None:
+            return make_device_sp_train_step(
+                sp_model, opt, mesh, FLAGS.batch_size,
+                keep_prob=FLAGS.keep_prob, chunk=length,
+                grad_transform=grad_transform,
+                per_token_targets=per_token_targets)
         if tp:
             # GSPMD: the state's TP layout + the data-axis batch constraint
             # drive the partitioner

@@ -249,9 +249,9 @@ def test_seq_parallel_mode_rejections(tmp_path):
         with pytest.raises(ValueError, match="must divide"):
             train(parse("--model=transformer", "--model_axis=8"),
                   mode="sync")
-        with pytest.raises(ValueError, match="not supported with"):
-            train(parse("--model=transformer", "--model_axis=4",
-                        "--device_data"), mode="sync")
+        # --device_data now COMPOSES with --seq_parallel (r5; see
+        # tests/test_device_step.py's composition tests) — the one
+        # remaining rejection is --augment
         with pytest.raises(ValueError, match="not supported with"):
             train(parse("--model=transformer", "--model_axis=4",
                         "--augment"), mode="sync")
