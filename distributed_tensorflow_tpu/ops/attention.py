@@ -263,23 +263,31 @@ def _ring_forward(q, k, v, axis_name, causal):
         return _online_softmax_step(qf, scale, o, m, l, k_blk, v_blk, mask)
 
     def ring_step(carry, t):
-        # rotate FIRST, then attend: the locally-held block is consumed
-        # outside the scan, so exactly P-1 ICI hops happen (a trailing
-        # rotation whose output nobody reads would not be DCE'd out of
-        # the compiled loop). After t rotations this device holds the
-        # block ORIGINALLY owned by shard (me - t) mod P.
-        o, m, l, k_blk, v_blk = carry
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
-        o, m, l = attend(o, m, l, k_blk, v_blk, (me - t) % p_size)
-        return (o, m, l, k_blk, v_blk), None
+        # DOUBLE-BUFFERED rotation: issue hop t+1's ppermute BEFORE
+        # consuming block t, so the collective has no consumer until the
+        # next iteration and XLA's async collective-permute overlaps it
+        # with this step's attend — the hop leaves the critical path
+        # (ICI hops are cheap; --sp_span_hosts DCN hops are the ones
+        # this hides). After t rotations this device holds the block
+        # ORIGINALLY owned by shard (me - t) mod P; accumulator math is
+        # identical to the rotate-then-attend form (same blocks, same
+        # order — trajectory-pinned by the SP tests).
+        o, m, l, k_cur, v_cur = carry
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        o, m, l = attend(o, m, l, k_cur, v_cur, (me - t) % p_size)
+        return (o, m, l, k_nxt, v_nxt), None
 
     o0 = jnp.zeros((b, h, sq, dh), jnp.float32)
     m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, sq), jnp.float32)
-    o, m, l = attend(o0, m0, l0, k, v, me)
-    (o, m, l, _, _), _ = lax.scan(
-        ring_step, (o, m, l, k, v), jnp.arange(1, p_size))
+    # P-1 scan iterations, each with one (prefetch) hop; the LAST block
+    # is consumed outside so no trailing rotation is compiled. Step 0
+    # attends the local block (owner = me) — causal masking needs it
+    # first so the running max is finite from the start.
+    (o, m, l, k_last, v_last), _ = lax.scan(
+        ring_step, (o0, m0, l0, k, v), jnp.arange(p_size - 1))
+    o, m, l = attend(o, m, l, k_last, v_last, (me - (p_size - 1)) % p_size)
     o = o / l[..., None]
     lse = m + jnp.log(l)
     out = jnp.einsum("bhqd->bqhd", o).astype(q.dtype)
@@ -321,17 +329,20 @@ def _ring_bwd(axis_name, causal, res, g):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
         owner = (me - t) % p_size
         mask = _ring_mask(causal, owner, k_cur.shape[1], row_global)
+        # half-double-buffered: the k/v prefetch hops are issued BEFORE
+        # the block compute (no consumer until next step — XLA overlaps
+        # them with _flash_bwd_block), halving the permute bytes left on
+        # the critical path. dk/dv genuinely depend on this step's
+        # output, so their hops follow the compute — they ride the ring
+        # WITH their blocks and arrive home after P hops regardless.
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
         dq_c, dk_blk, dv_blk = _flash_bwd_block(
             qf, gf, dD, lse, scale, k_cur, v_cur, mask)
         dq = dq + dq_c
-        dk_cur = dk_cur + dk_blk
-        dv_cur = dv_cur + dv_blk
-        # rotate blocks AND their gradient accumulators together
-        k_cur = lax.ppermute(k_cur, axis_name, perm)
-        v_cur = lax.ppermute(v_cur, axis_name, perm)
-        dk_cur = lax.ppermute(dk_cur, axis_name, perm)
-        dv_cur = lax.ppermute(dv_cur, axis_name, perm)
-        return (dq, k_cur, v_cur, dk_cur, dv_cur), None
+        dk_cur = lax.ppermute(dk_cur + dk_blk, axis_name, perm)
+        dv_cur = lax.ppermute(dv_cur + dv_blk, axis_name, perm)
+        return (dq, k_nxt, v_nxt, dk_cur, dv_cur), None
 
     dq0 = jnp.zeros((b, sq, h, dh), jnp.float32)
     z = jnp.zeros((b, k.shape[1], h, dh), jnp.float32)
