@@ -275,17 +275,23 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                         num_blocks=model.num_blocks,
                         mlp_ratio=model.mlp_dim // model.d_model,
                         compute_dtype=model.compute_dtype,
-                        attn_block=blk, remat=model.remat)
+                        attn_block=blk, remat=model.remat,
+                        ce_block=model.ce_block)
             # the SP twin ring-attends causally; identical params/math
             # to the dense model built above (blockwise/dense forms are
-            # its host-side evaluators)
+            # its host-side evaluators). ce_block carries over: inside
+            # shard_map the streamed head runs on the LOCAL (B, S/P, d)
+            # tile — its shard-local mean is exactly the per-token SP
+            # derivation's loss seed, so the uniform pmean reduction is
+            # unchanged (and the (B, S/P, V) logits never materialize,
+            # which is the point at large vocab)
             sp_model = TransformerLM(
                 vocab_size=model.vocab_size, seq_len=model.seq_len,
                 d_model=model.d_model, num_heads=model.num_heads,
                 num_blocks=model.num_blocks,
                 mlp_ratio=model.mlp_dim // model.d_model,
                 compute_dtype=model.compute_dtype, seq_axis=MODEL_AXIS,
-                remat=model.remat)
+                remat=model.remat, ce_block=model.ce_block)
         else:
             sp_model = MiniTransformer(
                 image_size=model.image_size, channels=model.channels,

@@ -587,3 +587,39 @@ def test_streamed_ce_out_of_range_labels_match_dense():
     g1 = jax.jit(jax.grad(stream_l, argnums=(0, 1, 2)))(h, w, b)
     for a, c in zip(g0, g1):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=1e-6)
+
+
+def test_sp_ce_block_matches_sp_dense_head():
+    """ce_block composes with SP: the streamed head's shard-local mean
+    is exactly the per-token derivation's loss seed, so trajectories
+    match the unstreamed SP step to fp tolerance."""
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    kw = dict(vocab_size=16, seq_len=32, d_model=32, num_heads=2,
+              num_blocks=2, seq_axis=MODEL_AXIS)
+    m_plain = TransformerLM(**kw)
+    m_ce = TransformerLM(**kw, ce_block=4)
+    # sgd, not adam: updates linear in grads, so the pin measures the
+    # streamed head's gradient fidelity instead of adam's sqrt(v)
+    # amplification of f32 accumulation-order ulps
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(m_plain, opt, seed=0)
+
+    states = []
+    for m in (m_plain, m_ce):
+        ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=5)  # same walk
+        state = replicate_state(mesh, base)
+        step = make_sp_train_step(m, opt, mesh, keep_prob=1.0,
+                                  per_token_targets=True, donate=False)
+        for i in range(3):
+            b = stage_batch_sp(mesh, ds.next_batch(8),
+                               per_token_targets=True)
+            state, metrics = step(state, b)
+        states.append((state, metrics))
+    (s0, m0), (s1, m1) = states
+    np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(m0["accuracy"]),
+                               float(m1["accuracy"]), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(s0.params), jax.tree.leaves(s1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)

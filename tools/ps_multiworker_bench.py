@@ -96,10 +96,9 @@ def worker_main(widx: int, n_workers: int, address: str, cycles: int,
 
 def _spawn_worker(widx: int, n: int, address: str, cycles: int,
                   gofile: str, errdir: str):
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (env.get("PYTHONPATH", ""), repo_root) if p)
+        p for p in (env.get("PYTHONPATH", ""), _REPO_ROOT) if p)
     # stderr goes to a FILE, not a pipe: a crashing worker can dump
     # >64KB of logging+traceback, and an undrained stderr pipe would
     # block its write -> stdout never reaches EOF -> parent deadlocks
@@ -154,39 +153,48 @@ def main(cycles: int = 60):
 
             procs = [_spawn_worker(w, n_workers, server.address, cycles,
                                    gofile, tmp) for w in range(n_workers)]
+            results = []
+            errors = []
             for p in procs:
                 # bound the READY wait: a worker wedged in init would
                 # otherwise block this readline forever. The killer
-                # makes readline return EOF ("") instead.
+                # makes readline return EOF ("") instead. A worker
+                # dying here is a per-N error record, not an abort —
+                # the remaining N still get measured.
                 killer = threading.Timer(300.0, p.kill)
                 killer.start()
                 try:
                     while True:  # skip stray library chatter on stdout
                         line = p.stdout.readline()
                         if line == "":
-                            raise RuntimeError(
-                                f"worker died/hung before READY: "
-                                f"{_err_tail(p)}")
+                            errors.append(f"worker died/hung before "
+                                          f"READY: {_err_tail(p)}")
+                            break
                         if line.strip() == "READY":
                             break
                 finally:
                     killer.cancel()
-            with open(gofile, "w"):
-                pass
-            results = []
-            errors = []
-            for p in procs:
-                try:
-                    out, _ = p.communicate(timeout=600)
-                except subprocess.TimeoutExpired:
-                    p.kill()
-                    out, _ = p.communicate()
-                    errors.append(f"worker timed out: {_err_tail(p)}")
-                    continue
-                if p.returncode != 0:
-                    errors.append(_err_tail(p))
-                    continue
-                results.append(json.loads(out.strip().splitlines()[-1]))
+            if not errors:
+                with open(gofile, "w"):
+                    pass
+                for p in procs:
+                    try:
+                        out, _ = p.communicate(timeout=600)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        out, _ = p.communicate()
+                        errors.append(f"worker timed out: {_err_tail(p)}")
+                        continue
+                    if p.returncode != 0:
+                        errors.append(_err_tail(p))
+                        continue
+                    try:
+                        results.append(
+                            json.loads(out.strip().splitlines()[-1]))
+                    except (ValueError, IndexError):
+                        errors.append(f"worker emitted no result JSON "
+                                      f"(stdout {out[-200:]!r}); "
+                                      f"{_err_tail(p)}")
             if errors:
                 print(json.dumps({"n_workers": n_workers,
                                   "errors": errors}), flush=True)
@@ -218,6 +226,9 @@ def main(cycles: int = 60):
                     p.kill()
             init_client.close()
             server.close()
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)  # go-file + .err files
 
 
 if __name__ == "__main__":
