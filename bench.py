@@ -108,6 +108,16 @@ LM_D_MODEL = 256
 LM_ATTN_BLOCK = 512
 LM_TIMED_STEPS = 20
 
+# large-vocab long-context phase (r5): V=32k x S=8k through the
+# STREAMED loss head (--ce_block custom VJP). The unstreamed head's
+# logits+grad alone would be 2 x B*S*V*4 = 8 GB f32 at this config —
+# past the chip; streamed, the loss peaks at O(ce_block * V).
+LM_BIGV_VOCAB = 32768
+LM_BIGV_SEQ_LEN = 8192
+LM_BIGV_BATCH = 4
+LM_BIGV_CE_BLOCK = 512
+LM_BIGV_TIMED_STEPS = 10
+
 
 def _sync_every(n_chips: int) -> int:
     """In-flight collective-program cap (see utils.collective_sync_cadence
@@ -329,17 +339,14 @@ def ps_emulation_phase(ds, wire: str = "f32") -> float:
         server.close()
 
 
-def lm_longctx_phase() -> dict:
-    """Long-context causal LM: tokens/sec/chip for the production train
-    step at 4096-token context — blockwise flash attention
-    (--attn_block 512, custom-VJP backward: O(S*block) memory both
-    passes), bf16, adam, batch 8. Also reports the XLA compiler's peak
-    temp allocation for the step (memory_analysis — the evidence that
-    the long-context path's memory claim holds on this hardware; the
-    dense form compile-fails at 2x this length, PERF.md round-4
-    sweep). The reference has no attention at all (images only,
-    MNISTDist.py:68) — this phase records the build's beyond-parity
-    flagship."""
+def _lm_phase(vocab: int, seq_len: int, batch: int, steps: int, *,
+              ce_block: int | None, prefix: str) -> dict:
+    """Shared LM bench recipe (both LM phases): build the production
+    train step (bf16, adam, blockwise flash attention; streamed-CE head
+    when ``ce_block``), AOT-compile for the compiler's exact peak-temp
+    figure (falling back to plain jit on AOT quirks), warm up with a
+    hard readback, then time ``steps`` steps. One implementation so the
+    timing/readback/fallback discipline cannot drift between phases."""
     from distributed_tensorflow_tpu.data.lm import LMDataSet
     from distributed_tensorflow_tpu.models.transformer import TransformerLM
     from distributed_tensorflow_tpu.training import (
@@ -348,16 +355,15 @@ def lm_longctx_phase() -> dict:
         make_train_step,
     )
 
-    seq_len, batch, steps = LM_SEQ_LEN, LM_BATCH, LM_TIMED_STEPS
-    model = TransformerLM(vocab_size=64, seq_len=seq_len,
-                          d_model=LM_D_MODEL,
-                          num_heads=4, num_blocks=4,
-                          attn_block=LM_ATTN_BLOCK,
+    model = TransformerLM(vocab_size=vocab, seq_len=seq_len,
+                          d_model=LM_D_MODEL, num_heads=4, num_blocks=4,
+                          attn_block=LM_ATTN_BLOCK, ce_block=ce_block,
                           compute_dtype=jnp.bfloat16)
     opt = adam(1e-3)
     state = create_train_state(model, opt, seed=0)
     step = make_train_step(model, opt, keep_prob=1.0)
-    ds = LMDataSet(64, seq_len=seq_len, vocab_size=64, seed=0)
+    ds = LMDataSet(max(batch, 4), seq_len=seq_len, vocab_size=vocab,
+                   seed=0)
     b = ds.next_batch(batch)
     temp_bytes = 0
     try:
@@ -371,13 +377,47 @@ def lm_longctx_phase() -> dict:
     state, m = runner(state, b)
     float(m["loss"])  # hard readback: clean clock
     t0 = time.perf_counter()
-    for i in range(steps):
+    for _ in range(steps):
         state, m = runner(state, ds.next_batch(batch))
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
-    return {"lm_4k_tokens_per_sec_per_chip": round(steps * batch * seq_len / dt),
-            "lm_4k_step_temp_bytes": temp_bytes,
-            "lm_seq_len": seq_len}
+    return {f"{prefix}_tokens_per_sec_per_chip":
+                round(steps * batch * seq_len / dt),
+            f"{prefix}_step_temp_bytes": temp_bytes}
+
+
+def lm_longctx_phase() -> dict:
+    """Long-context causal LM: tokens/sec/chip for the production train
+    step at 4096-token context — blockwise flash attention
+    (--attn_block 512, custom-VJP backward: O(S*block) memory both
+    passes), bf16, adam, batch 8. Also reports the XLA compiler's peak
+    temp allocation for the step (memory_analysis — the evidence that
+    the long-context path's memory claim holds on this hardware; the
+    dense form compile-fails at 2x this length, PERF.md round-4
+    sweep). The reference has no attention at all (images only,
+    MNISTDist.py:68) — this phase records the build's beyond-parity
+    flagship."""
+    out = _lm_phase(64, LM_SEQ_LEN, LM_BATCH, LM_TIMED_STEPS,
+                    ce_block=None, prefix="lm_4k")
+    out["lm_seq_len"] = LM_SEQ_LEN
+    return out
+
+
+def lm_largevocab_phase() -> dict:
+    """Large-vocab long context: tokens/sec/chip for the production
+    train step at LM_BIGV_VOCAB x LM_BIGV_SEQ_LEN with BOTH streams on
+    — blockwise flash attention (O(S*block)) and the streamed
+    softmax-CE head (O(ce_block*V), custom VJP; ops/nn.py). At this
+    config the UNSTREAMED head's logits+grad alone exceed the chip
+    (the r5 vocab sweep records the naive wall); this phase is the
+    driver-captured evidence that large-vocab long context trains on
+    one chip. Reports the compiler's exact peak temp allocation."""
+    out = _lm_phase(LM_BIGV_VOCAB, LM_BIGV_SEQ_LEN, LM_BIGV_BATCH,
+                    LM_BIGV_TIMED_STEPS, ce_block=LM_BIGV_CE_BLOCK,
+                    prefix="lm_bigvocab")
+    out["lm_bigvocab_vocab"] = LM_BIGV_VOCAB
+    out["lm_bigvocab_seq_len"] = LM_BIGV_SEQ_LEN
+    return out
 
 
 def feeddict_baseline_phase(ds, n_chips) -> float:
@@ -706,6 +746,7 @@ def _run_phases(out: dict):
         out["ps_emulation_bf16_images_per_sec"] = round(
             ps_emulation_phase(ds, wire="bf16"), 1)
     out.update(lm_longctx_phase())
+    out.update(lm_largevocab_phase())
 
     print(json.dumps(out))
 

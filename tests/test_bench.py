@@ -242,3 +242,17 @@ def test_main_phase_software_error_exits_nonzero(monkeypatch, capsys):
     assert rec["phase_error"] is True
     assert rec["tpu_unavailable"] is False
     assert rec["n_chips"] == 1
+
+
+def test_lm_largevocab_phase_runs(monkeypatch):
+    monkeypatch.setattr(bench, "LM_BIGV_VOCAB", 512)
+    monkeypatch.setattr(bench, "LM_BIGV_SEQ_LEN", 64)
+    monkeypatch.setattr(bench, "LM_BIGV_BATCH", 2)
+    monkeypatch.setattr(bench, "LM_BIGV_CE_BLOCK", 16)
+    monkeypatch.setattr(bench, "LM_BIGV_TIMED_STEPS", 2)
+    monkeypatch.setattr(bench, "LM_D_MODEL", 32)
+    monkeypatch.setattr(bench, "LM_ATTN_BLOCK", 16)
+    out = bench.lm_largevocab_phase()
+    assert out["lm_bigvocab_tokens_per_sec_per_chip"] > 0
+    assert out["lm_bigvocab_vocab"] == 512
+    assert out["lm_bigvocab_seq_len"] == 64
