@@ -38,19 +38,54 @@ _CNN_TP_SPECS = {
 }
 
 
+def _transformer_tp_table(all_keys) -> dict:
+    """Megatron-style block split for the transformer families
+    (models/transformer._block_params): attention HEADS over the model
+    axis (qkv (d, 3, h, dh) on its head dim; proj (h*dh, d) row-split —
+    the head-major flatten keeps the split on head boundaries), MLP
+    column- then row-split (in/w + in/b over mlp_dim; out/w contracting
+    over it). XLA's partitioner derives the one psum each row-split
+    contraction needs. Embeddings / positional / layernorms / the vocab
+    head replicate: at these widths their FLOPs don't pay for
+    collective traffic, and the large-VOCAB memory problem is solved by
+    the streamed CE head (ops/nn.py), not by sharding."""
+    table = {}
+    for keys in all_keys:
+        if len(keys) >= 3 and keys[0] == "blocks":
+            leaf = keys[2:]
+            if leaf == ("qkv",):
+                table[keys] = P(None, None, MODEL_AXIS, None)
+            elif leaf == ("proj",):
+                table[keys] = P(MODEL_AXIS, None)
+            elif leaf == ("mlp_in", "w"):
+                table[keys] = P(None, MODEL_AXIS)
+            elif leaf == ("mlp_in", "b"):
+                table[keys] = P(MODEL_AXIS)
+            elif leaf == ("mlp_out", "w"):
+                table[keys] = P(MODEL_AXIS, None)
+    return table
+
+
 def tp_param_specs(params) -> dict:
-    """PartitionSpec pytree mirroring ``params``: FC stack split over the
-    model axis, everything else replicated. The split rule applies only
-    when the params carry the CNN's full FC stack (wd1 present) — a model
-    that merely shares a leaf NAME with the table (e.g. the MLP's "out")
-    must not have that one matmul split in isolation, which would buy a
-    collective and shard nothing that matters."""
+    """PartitionSpec pytree mirroring ``params``: the model family's
+    split table over the model axis, everything else replicated. The
+    CNN rule applies only when the params carry the full FC stack (wd1
+    present) — a model that merely shares a leaf NAME with the table
+    (e.g. the MLP's "out") must not have that one matmul split in
+    isolation, which would buy a collective and shard nothing that
+    matters. Transformer params (a "blocks" list of the shared block
+    layout) get the Megatron block split."""
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     all_keys = {
         tuple(getattr(p, "key", getattr(p, "idx", None)) for p in path)
         for path, _ in flat
     }
-    table = _CNN_TP_SPECS if ("weights", "wd1") in all_keys else {}
+    if ("weights", "wd1") in all_keys:
+        table = _CNN_TP_SPECS
+    elif ("blocks", 0, "qkv") in all_keys:
+        table = _transformer_tp_table(all_keys)
+    else:
+        table = {}
     specs = {}
     for path, _ in flat:
         keys = tuple(getattr(p, "key", getattr(p, "idx", None)) for p in path)

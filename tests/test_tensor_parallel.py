@@ -236,3 +236,90 @@ def test_model_axis_composes_with_device_data(tmp_path, capsys):
     # resume from the step-20 checkpoint: restage restores the TP layout
     res2 = run(30)
     assert res2.final_step == 30
+
+
+# --------------------------- transformer-family TP (r5, Megatron split)
+
+
+def test_transformer_tp_specs_rules():
+    from distributed_tensorflow_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=16, seq_len=32, d_model=32,
+                          num_heads=4, num_blocks=2)
+    specs = tp_param_specs(model.init(jax.random.PRNGKey(0)))
+    blk = specs["blocks"][0]
+    assert blk["qkv"] == P(None, None, MODEL_AXIS, None)
+    assert blk["proj"] == P(MODEL_AXIS, None)
+    assert blk["mlp_in"]["w"] == P(None, MODEL_AXIS)
+    assert blk["mlp_in"]["b"] == P(MODEL_AXIS)
+    assert blk["mlp_out"]["w"] == P(MODEL_AXIS, None)
+    assert blk["mlp_out"]["b"] == P()
+    # embeddings / head / norms replicate
+    assert specs["tok"] == P() and specs["pos"] == P()
+    assert specs["head"]["w"] == P() and specs["ln_f"]["g"] == P()
+
+
+def test_transformer_tp_step_equals_single_device_step():
+    """The Megatron block split must not change the math: TP(+DP) LM
+    trajectory == single-device trajectory on the same batches."""
+    from distributed_tensorflow_tpu.data.lm import LMDataSet
+    from distributed_tensorflow_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=16, seq_len=32, d_model=32,
+                          num_heads=4, num_blocks=2)
+    opt = sgd(0.05)
+    base = create_train_state(model, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+
+    single = create_train_state(model, opt, seed=0)
+    step1 = make_train_step(model, opt, keep_prob=1.0, donate=False)
+    tp_state = shard_state_tp(base, mesh)
+    stepN = make_tp_train_step(model, opt, mesh, keep_prob=1.0,
+                               donate=False)
+
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=7)
+    for _ in range(3):
+        b = ds.next_batch(8)
+        single, m1 = step1(single, b)
+        tp_state, mN = stepN(tp_state, stage_batch_tp(mesh, b))
+    np.testing.assert_allclose(float(m1["loss"]), float(mN["loss"]),
+                               rtol=2e-5)
+    for a, b_ in zip(jax.tree.leaves(single.params),
+                     jax.tree.leaves(tp_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-5)
+    # the split actually sharded: a block's mlp_in has 1/4 local width
+    w = tp_state.params["blocks"][0]["mlp_in"]["w"]
+    assert w.addressable_shards[0].data.shape[1] == w.shape[1] // 4
+
+
+def test_lm_model_axis_cli(tmp_path):
+    """--model lm --model_axis now takes the TP branch (no seq_parallel)
+    and trains through the production CLI; misaligned head counts are
+    rejected loudly."""
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    try:
+        flags.FLAGS._reset()
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/l", f"--data_dir={tmp_path}/n",
+            "--dataset=lm", "--model=lm", "--model_axis=2",
+            "--seq_len=32", "--vocab_size=16", "--num_heads=4",
+            "--batch_size=8", "--training_iter=4", "--display_step=2",
+            "--test_eval=false",
+        ])
+        res = train(flags.FLAGS, mode="sync")
+        assert res.final_step == 4 and np.isfinite(res.train_metrics["loss"])
+        flags.FLAGS._reset()
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/l2", f"--data_dir={tmp_path}/n",
+            "--dataset=lm", "--model=lm", "--model_axis=8",
+            "--seq_len=32", "--vocab_size=16", "--num_heads=4",
+            "--batch_size=8", "--training_iter=2",
+        ])
+        with pytest.raises(ValueError, match="must divide"):
+            train(flags.FLAGS, mode="sync")
+    finally:
+        flags.FLAGS._reset()
