@@ -90,14 +90,27 @@ def tp_param_specs(params) -> dict:
     for path, _ in flat:
         keys = tuple(getattr(p, "key", getattr(p, "idx", None)) for p in path)
         specs[keys] = table.get(keys, P())
-    # rebuild the nested dict shape
+    # rebuild the nested shape
     out: dict = {}
     for keys, spec in specs.items():
         node = out
         for k in keys[:-1]:
             node = node.setdefault(k, {})
         node[keys[-1]] = spec
-    return out
+    return _listify(out)
+
+
+def _listify(node):
+    """Int-keyed dicts (list indices from the path walk) back to LISTS,
+    so the returned spec tree STRUCTURALLY mirrors params — a caller's
+    plain ``jax.tree.map(f, params, specs)`` must work (the transformer
+    families' "blocks" list is the first input that exercises this)."""
+    if isinstance(node, dict) and node and all(
+            isinstance(k, int) for k in node):
+        return [_listify(node[i]) for i in range(len(node))]
+    if isinstance(node, dict):
+        return {k: _listify(v) for k, v in node.items()}
+    return node
 
 
 def has_tp_specs(params) -> bool:
@@ -138,10 +151,29 @@ def _opt_sharding(entry, params_structure, pspecs, mesh, rep):
     return jax.tree.map(lambda _: rep, entry)
 
 
+def _check_divisibility(params, pspecs, mesh) -> None:
+    """Every split dim must divide by the model-axis size — shape-based
+    and at the LIBRARY layer, so every caller is protected (GSPMD would
+    otherwise silently pad + reshard off head/column boundaries)."""
+    ways = mesh.shape[MODEL_AXIS]
+    for leaf, spec in zip(jax.tree.leaves(params),
+                          jax.tree.leaves(
+                              pspecs, is_leaf=lambda x: isinstance(x, P))):
+        for d, axis in enumerate(spec):
+            if axis == MODEL_AXIS and leaf.shape[d] % ways:
+                raise ValueError(
+                    f"model-axis size {ways} must divide the sharded "
+                    f"dim {d} (= {leaf.shape[d]}) of a leaf with shape "
+                    f"{leaf.shape}; pick a --model_axis that divides "
+                    f"the model's head count and MLP width")
+
+
 def tp_state_sharding(state: TrainState, mesh: Mesh) -> TrainState:
     """Sharding pytree matching ``state``: params (and their optimizer
-    slots) follow ``tp_param_specs``; scalars and rng replicated."""
+    slots) follow ``tp_param_specs``; scalars and rng replicated.
+    Refuses shapes the model axis does not divide."""
     pspecs = tp_param_specs(state.params)
+    _check_divisibility(state.params, pspecs, mesh)
     rep = NamedSharding(mesh, P())
     params_sh = _map_specs(state.params, pspecs, mesh)
     opt_sh = _opt_sharding(state.opt_state, jax.tree.structure(state.params),

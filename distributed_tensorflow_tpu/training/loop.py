@@ -387,15 +387,9 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 f"replicate and the extra devices would do redundant work. "
                 f"Use --model_axis=1 (data parallelism) for this model."
             )
-        for attr, what in (("num_heads", "attention heads"),
-                           ("mlp_dim", "MLP width")):
-            v = getattr(model, attr, None)
-            if v is not None and v % model_axis:
-                # GSPMD would still compile (padding + reshards), but
-                # silently off the clean head/column boundaries — refuse
-                raise ValueError(
-                    f"--model_axis={model_axis} must divide the model's "
-                    f"{what} ({attr}={v}) for the transformer TP split")
+        # shape/axis divisibility is enforced at the library layer
+        # (tensor_parallel._check_divisibility, raised from
+        # shard_state_tp below) so non-CLI callers are protected too
         mesh = make_mesh(MeshSpec(data=-1, model=model_axis))
         n_chips = mesh.devices.size
         data_ways = mesh.shape[DATA_AXIS]
