@@ -270,6 +270,17 @@ def define_reference_flags():
                    "materialize; O(block*V) peak both passes). The "
                    "large-vocab half of the long-context memory story; "
                    "lm model only")
+    DEFINE_boolean("pipeline", False, "GPipe-style pipeline parallelism "
+                   "for --model lm: transformer blocks staged "
+                   "--model_axis ways over the mesh's 'model' axis, "
+                   "activations ppermute stage-to-stage while every "
+                   "stage works a different microbatch "
+                   "(parallel/pipeline_parallel.py). Mutually exclusive "
+                   "with --seq_parallel; num_blocks must divide by "
+                   "--model_axis")
+    DEFINE_integer("pp_microbatches", 0, "Microbatches per step under "
+                   "--pipeline (0 = the stage count, the GPipe "
+                   "default); must divide the per-data-shard batch")
     DEFINE_boolean("remat", False, "Rematerialize each transformer block "
                    "in the backward pass (jax.checkpoint): activation "
                    "memory drops to one block's worth at the cost of "

@@ -1,0 +1,344 @@
+"""Pipeline parallelism: transformer blocks staged over the "model" axis.
+
+The reference has no model parallelism at all (SURVEY.md §2c); the mesh
+keeps a "model" axis open, and this module makes it real a THIRD way
+(after tensor_parallel's Megatron split and sequence_parallel's token
+sharding): GPipe-style PIPELINE parallelism — each device owns a
+contiguous run of transformer blocks (a STAGE), the global batch splits
+into M microbatches, and activations flow stage-to-stage on the ring
+while every stage works on a different microbatch each tick.
+
+TPU-idiomatic formulation (no hand-written schedule, no host control):
+
+- Stage parameters are the model's ``blocks`` list STACKED on a leading
+  axis and sharded over "model" — each device holds (L, ...) leaves,
+  L = num_blocks / K. ``stack_block_params`` / ``unstack_block_params``
+  convert to/from the standard layout so CHECKPOINTS stay in the one
+  shared pytree format (SURVEY.md §7 hard part d).
+- One ``lax.scan`` over M + K - 1 ticks inside ``shard_map``. At tick
+  t, the device at stage s processes microbatch (t - s): stage 0
+  ingests (embeds) microbatch t, inner stages transform the activation
+  they received last tick, the last stage computes that microbatch's
+  loss contribution. One ``ppermute`` per tick moves activations to
+  the next stage. Out-of-range microbatch indices are masked with
+  ``where`` — every device runs the identical program (SPMD), and the
+  bubble ticks contribute exact zeros.
+- The BACKWARD pipeline is not written at all: reverse-mode AD of the
+  scan + ppermute IS the backward schedule (ppermute's transpose is
+  the reverse rotation, carrying output cotangents back through the
+  stages in reverse tick order) — the same property the ring
+  attention backward builds on.
+
+Gradient reduction (cf. sequence_parallel's two derivations): the loss
+is a ``psum`` over the stage axis of the last stage's masked
+contributions, so each device's AD computes exact PARTIALS of the
+global loss: stage-sharded block leaves need NO cross-stage reduction
+(they are different shards of the stacked tree), while the replicated
+leaves (embeddings, final norm, head) get nonzero gradients only on
+the stages that use them (0 and K-1) — one ``psum`` over the stage
+axis totals them. Then the usual pmean over "data" for DP.
+
+Exactness: the pipeline computes literally the same function as
+running each microbatch through all blocks sequentially, so gradients
+match the gradient-accumulation step (``compute_grads(accum_steps=M)``)
+to float tolerance — pinned by tests/test_pipeline_parallel.py.
+Dropout draws a distinct key per microbatch exactly as accumulation
+does, so trajectories match WITH dropout too.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.models.transformer import (
+    _layernorm,
+    _transformer_block,
+)
+from distributed_tensorflow_tpu.ops import nn
+from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from distributed_tensorflow_tpu.training.train_state import (
+    TrainState,
+    apply_updates,
+)
+
+
+def stack_block_params(params):
+    """Standard layout (``blocks`` = list of per-block dicts) -> stacked
+    (one dict whose leaves carry a leading num_blocks axis). Everything
+    else passes through. The stacked form is what shards over the
+    stage axis; checkpoints always store the standard form."""
+    blocks = params["blocks"]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    out = dict(params)
+    out["blocks"] = stacked
+    return out
+
+
+def unstack_block_params(params, num_blocks: int):
+    """Inverse of ``stack_block_params`` (host-side: checkpoint fetch)."""
+    stacked = params["blocks"]
+    blocks = [jax.tree.map(lambda x: x[i], stacked)
+              for i in range(num_blocks)]
+    out = dict(params)
+    out["blocks"] = blocks
+    return out
+
+
+def _map_params_shaped(entry, pstruct, fn, passthrough):
+    """Apply ``fn`` to every opt-state subtree that structurally mirrors
+    params; recurse through dict containers; ``passthrough`` handles
+    everything else (scalar slots, step counts). The ONE implementation
+    of the rule every PP state transform needs — stack, unstack,
+    shardings, specs — so a future non-dict slot container gets fixed
+    in one place."""
+    if jax.tree.structure(entry) == pstruct:
+        return fn(entry)
+    if isinstance(entry, dict):
+        return {k: _map_params_shaped(v, pstruct, fn, passthrough)
+                for k, v in entry.items()}
+    return passthrough(entry)
+
+
+def pp_state_sharding(state: TrainState, mesh):
+    """Shardings for a STACKED-params TrainState: block leaves split on
+    their leading (stage) axis over "model", everything else
+    replicated; optimizer slots follow their params (structure-matched:
+    slot subtrees that mirror params take the params shardings, scalars
+    replicate). Derived from ``pp_state_specs`` — one statement of the
+    blocks-vs-replicated rule."""
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                        pp_state_specs(state),
+                        is_leaf=lambda v: isinstance(v, P))
+
+
+def pp_state_specs(state: TrainState) -> TrainState:
+    """PartitionSpec pytree for a STACKED-params TrainState — the one
+    place the blocks-split-over-model rule is written (shard_map specs
+    and device shardings both derive from it)."""
+    def block_or_rep(path, _leaf):
+        keys = tuple(getattr(p, "key", getattr(p, "idx", None))
+                     for p in path)
+        return P(MODEL_AXIS) if keys[:1] == ("blocks",) else P()
+
+    pspecs = jax.tree_util.tree_map_with_path(block_or_rep, state.params)
+    pstruct = jax.tree.structure(state.params)
+    pleaves = jax.tree.leaves(pspecs, is_leaf=lambda v: isinstance(v, P))
+    opt = _map_params_shaped(
+        state.opt_state, pstruct,
+        lambda e: jax.tree.unflatten(pstruct, pleaves),
+        lambda e: jax.tree.map(lambda _: P(), e))
+    return TrainState(params=pspecs, opt_state=opt, step=P(), rng=P(),
+                      model_state=jax.tree.map(lambda _: P(),
+                                               state.model_state))
+
+
+def shard_state_pp(state: TrainState, mesh) -> TrainState:
+    """Stack the blocks list and place the state with the PP layout."""
+    stacked = state._replace(params=stack_block_params(state.params))
+    stacked = stacked._replace(opt_state=_map_params_shaped(
+        state.opt_state, jax.tree.structure(state.params),
+        stack_block_params, lambda e: e))
+    return jax.device_put(stacked, pp_state_sharding(stacked, mesh))
+
+
+def fetch_state_pp(state: TrainState, model) -> TrainState:
+    """PP-layout state -> host state in the STANDARD layout (checkpoint
+    format): unstack blocks in params and any params-shaped opt slots."""
+    host = jax.device_get(state)
+    n = model.num_blocks
+    params = unstack_block_params(host.params, n)
+    return host._replace(
+        params=params,
+        opt_state=_map_params_shaped(
+            host.opt_state, jax.tree.structure(host.params),
+            lambda e: unstack_block_params(e, n), lambda e: e))
+
+
+def _attn_for(model):
+    """The model's single-device attention flavor (causal; dense or
+    blockwise) — PP stages run the SAME block math the plain model
+    runs, so the flavor selection must match apply_hidden's."""
+    from distributed_tensorflow_tpu.ops.attention import (
+        blockwise_attention,
+        multi_head_attention,
+    )
+
+    if model.attn_block is not None:
+        return lambda q, k, v: blockwise_attention(
+            q, k, v, model.attn_block, causal=True)
+    return lambda q, k, v: multi_head_attention(q, k, v, causal=True)
+
+
+def make_pp_train_step(model, optimizer, mesh, microbatches: int,
+                       keep_prob: float = 1.0, donate: bool = True,
+                       grad_transform=None):
+    """Compiled pipeline-parallel train step for ``TransformerLM``:
+    (PP-layout state, staged batch) -> (state, metrics).
+
+    The mesh's "model" axis size is the stage count K; ``microbatches``
+    (M) must divide the per-data-shard batch. The model must be a plain
+    (seq_axis=None) LM — attention flavors (dense or ``attn_block``)
+    and the streamed CE head (``ce_block``) all work; blocks split K
+    ways. Matches ``compute_grads(accum_steps=M)`` trajectories (the
+    per-microbatch rng fold is the same)."""
+    if getattr(model, "seq_axis", None) is not None:
+        raise ValueError("pipeline parallelism stages BLOCKS; it does "
+                         "not compose with seq_axis (ring attention) — "
+                         "pick one model-axis strategy")
+    k_stages = mesh.shape[MODEL_AXIS]
+    if model.num_blocks % k_stages:
+        raise ValueError(
+            f"num_blocks={model.num_blocks} must divide into "
+            f"{k_stages} pipeline stages")
+    cd = model.compute_dtype
+    m = int(microbatches)
+
+    def step(state: TrainState, batch):
+        x, y = batch
+        if x.shape[0] % m:
+            raise ValueError(f"per-shard batch {x.shape[0]} must split "
+                             f"into {m} microbatches")
+        s_idx = lax.axis_index(MODEL_AXIS)
+        rng, sub = jax.random.split(state.rng)
+        sub = jax.random.fold_in(sub, lax.axis_index(DATA_AXIS))
+
+        def loss_fn(params):
+            return _pp_loss(model, params, x, y, sub, m, k_stages,
+                            s_idx, keep_prob, cd)
+
+        grads, (loss, acc) = jax.grad(loss_fn, has_aux=True)(state.params)
+        # the differentiated loss was LOCAL (nonzero on the last stage
+        # only): psum totals it for reporting, and the same psum totals
+        # the replicated leaves' per-stage partials. Stage-sharded block
+        # leaves are exact partials already (distinct shards routed home
+        # by the ppermute transposes) — no stage-axis reduction
+        loss = lax.psum(loss, MODEL_AXIS)
+        acc = lax.psum(acc, MODEL_AXIS)
+
+        def reduce_g(path, g):
+            keys = tuple(getattr(p, "key", getattr(p, "idx", None))
+                         for p in path)
+            if keys and keys[0] == "blocks":
+                return g
+            return lax.psum(g, MODEL_AXIS)
+
+        grads = jax.tree_util.tree_map_with_path(reduce_g, grads)
+        grads = jax.tree.map(lambda g: lax.pmean(g, DATA_AXIS), grads)
+        if grad_transform is not None:
+            grads = grad_transform(grads)
+        metrics = {"loss": lax.pmean(loss, DATA_AXIS),
+                   "accuracy": lax.pmean(acc, DATA_AXIS)}
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params, state.step)
+        params = apply_updates(state.params, updates)
+        return (TrainState(params, opt_state, state.step + 1, rng,
+                           state.model_state), metrics)
+
+    data_spec = (P(DATA_AXIS, None), P(DATA_AXIS, None))
+    cache: dict = {}
+
+    def call(state, batch):
+        fn = cache.get("fn")
+        if fn is None:
+            sharded = jax.shard_map(
+                step, mesh=mesh,
+                in_specs=(pp_state_specs(state), data_spec),
+                out_specs=(pp_state_specs(state), P()),
+                check_vma=False)
+            fn = cache["fn"] = jax.jit(
+                sharded, donate_argnums=(0,) if donate else ())
+        return fn(state, batch)
+
+    return call
+
+
+def _pp_loss(model, params, x, y, sub, m, k_stages, s_idx, keep_prob, cd):
+    """The pipelined forward + loss (see module docstring): returns
+    (global mean loss, (loss, accuracy)) — grad'd with has_aux."""
+    tok, pos = params["tok"], params["pos"]
+    blocks = params["blocks"]
+    lnf, head = params["ln_f"], params["head"]
+    mb = x.shape[0] // m
+    xm = x.reshape(m, mb, x.shape[1])
+    ym = y.reshape(m, mb, y.shape[1])
+    perm = [(i, (i + 1) % k_stages) for i in range(k_stages)]
+    attn = _attn_for(model)
+    blk_fn = _transformer_block
+    if getattr(model, "remat", False):
+        # same remat the plain model applies (apply_hidden): one
+        # block's activations live at a time, recompute in the backward
+        blk_fn = jax.checkpoint(_transformer_block, static_argnums=(2, 3))
+
+    def embed(ids):
+        h = jnp.take(tok, ids, axis=0) + pos.astype(tok.dtype)
+        return h.astype(cd) if cd is not None else h
+
+    def stage(h):
+        def body(h, blk):
+            return blk_fn(h, blk, attn, cd), None
+        h, _ = lax.scan(body, h, blocks)
+        return h
+
+    def head_loss(h, targets, key):
+        h = _layernorm(h, lnf["g"], lnf["b"])
+        h = nn.dropout(h, keep_prob, key,
+                       deterministic=keep_prob >= 1.0)
+        if getattr(model, "ce_block", None):
+            return nn.streamed_softmax_ce_head(
+                h, head["w"], head["b"], targets,
+                block=model.ce_block, compute_dtype=cd)
+        logits = nn.dense(h, head["w"], head["b"],
+                          compute_dtype=cd).astype(jnp.float32)
+        return (nn.softmax_cross_entropy(logits, targets),
+                nn.accuracy(logits, targets))
+
+    def tick(carry, t):
+        # embed/head are GATED with lax.cond on the stage index, not
+        # computed-then-masked: K-1 of K stages would otherwise burn
+        # the full vocab-head FLOPs every tick — at large V (the
+        # ce_block composition) that is comparable to a block's cost
+        # and would eat the pipeline speedup
+        h_cur = carry
+        h_in = lax.cond(
+            s_idx == 0,
+            lambda: embed(xm[jnp.clip(t, 0, m - 1)]).astype(h_cur.dtype),
+            lambda: h_cur)
+        h_out = stage(h_in)
+        mb_i = t - (k_stages - 1)
+        valid_mb = (mb_i >= 0) & (mb_i < m)
+        loss, acc = lax.cond(
+            (s_idx == k_stages - 1) & valid_mb,
+            lambda: head_loss(h_out, ym[jnp.clip(mb_i, 0, m - 1)],
+                              jax.random.fold_in(
+                                  sub, jnp.clip(mb_i, 0, m - 1))),
+            lambda: (jnp.float32(0.0), jnp.float32(0.0)))
+        h_next = lax.ppermute(h_out, MODEL_AXIS, perm)
+        return h_next, (loss, acc)
+
+    h0 = jnp.zeros((mb, x.shape[1], model.d_model),
+                   cd if cd is not None else jnp.float32)
+    _, (losses, accs) = lax.scan(tick, h0, jnp.arange(m + k_stages - 1))
+    # LOCAL loss only — no psum inside the differentiated function.
+    # Grad seeds cotangent 1.0 on the last stage's (only nonzero) local
+    # loss; the ppermute transposes route that backward through earlier
+    # stages, so per-device grads EXACTLY PARTITION dL/dtheta (the SP
+    # per-token derivation's pattern). A psum here instead would seed
+    # every stage's replicated copy and K-scale every gradient (psum's
+    # transpose is another psum — the known trap).
+    return jnp.sum(losses) / m, (jnp.sum(losses) / m, jnp.sum(accs) / m)
+
+
+def stage_batch_pp(mesh, batch):
+    """(x, y) -> device arrays: batch split over "data", REPLICATED over
+    the stage axis (ids are tiny; every stage sees the full token ids
+    but only stage 0 embeds and only stage K-1 scores)."""
+    from distributed_tensorflow_tpu.parallel.mesh import put_global
+
+    return put_global(
+        (NamedSharding(mesh, P(DATA_AXIS, None)),
+         NamedSharding(mesh, P(DATA_AXIS, None))),
+        batch,
+    )

@@ -192,6 +192,13 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 f"--batch_size={FLAGS.batch_size} must be divisible by "
                 f"--accum_steps={accum}"
             )
+    if getattr(FLAGS, "pipeline", False):
+        if getattr(FLAGS, "seq_parallel", False):
+            raise ValueError("--pipeline (staged blocks) and "
+                             "--seq_parallel (token sharding) are "
+                             "mutually exclusive model-axis strategies")
+        return _train_pipeline(FLAGS, ds, model, opt, state, mode,
+                               model_axis, clip)
     sp_device_model = None  # set by the SP branch for --device_data
     if getattr(FLAGS, "seq_parallel", False):
         # sequence/context parallelism: tokens sharded --model_axis ways,
@@ -865,6 +872,127 @@ class _HostCoordinator:
             self._sv.checkpoint_coordinated(
                 state, step, attempt=format(int(votes[0, 2]), "08x"))
         self._stop = bool(votes[:, 0].max())
+
+
+def _train_pipeline(FLAGS, ds, model, opt, state, mode, model_axis,
+                    clip) -> TrainResult:
+    """--pipeline training: GPipe-style staged transformer blocks over
+    the mesh's "model" axis (parallel/pipeline_parallel.py).
+
+    The live state holds STACKED stage-sharded blocks; checkpoints stay
+    in the standard layout (fetch_state_pp unstacks at every display /
+    eval / cadence boundary, which is also when the StateBox updates —
+    so clean exits and SIGTERM drains save the exact final state; a
+    hard kill can lose at most the steps since the last boundary).
+    Display prints the step's own training metrics (the device-resident
+    mode's documented trade — the per-step host batch the reference's
+    pre-update eval wants would stall the pipeline)."""
+    from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
+    from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS
+    from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+        fetch_state_pp,
+        make_pp_train_step,
+        shard_state_pp,
+        stage_batch_pp,
+    )
+
+    if ds.meta.get("kind") != "lm":
+        raise ValueError("--pipeline stages transformer blocks; use "
+                         "--model lm --dataset lm")
+    if mode != "sync":
+        raise ValueError("--pipeline requires sync mode (a device mesh)")
+    if model_axis < 2:
+        raise ValueError(f"--pipeline stages blocks --model_axis ways; "
+                         f"--model_axis={model_axis} stages nothing")
+    if jax.process_count() > 1:
+        raise ValueError("--pipeline is single-process in this version "
+                         "(the stage ring would need the multi-host "
+                         "coordinator); use --seq_parallel "
+                         "--sp_span_hosts for cross-host model axes")
+    for flag in ("device_data", "augment"):
+        if getattr(FLAGS, flag, False):
+            raise ValueError(f"--{flag} is not supported with --pipeline")
+    if max(1, getattr(FLAGS, "accum_steps", 1)) > 1:
+        raise ValueError("--accum_steps is redundant with --pipeline: "
+                         "microbatching IS the pipeline schedule — set "
+                         "--pp_microbatches instead")
+
+    mesh = make_mesh(MeshSpec(data=-1, model=model_axis))
+    n_chips = mesh.devices.size
+    data_ways = mesh.shape[DATA_AXIS]
+    micro = int(getattr(FLAGS, "pp_microbatches", 0)) or model_axis
+    if FLAGS.batch_size % data_ways:
+        raise ValueError(f"--batch_size={FLAGS.batch_size} must divide "
+                         f"over the {data_ways}-way data axis")
+    if (FLAGS.batch_size // data_ways) % micro:
+        raise ValueError(
+            f"each data shard's slice ({FLAGS.batch_size // data_ways}) "
+            f"must split into {micro} microbatches (--pp_microbatches)")
+
+    step_fn = make_pp_train_step(model, opt, mesh, micro,
+                                 keep_prob=FLAGS.keep_prob,
+                                 grad_transform=clip)
+    sv = Supervisor(
+        is_chief=(FLAGS.task_index == 0),
+        logdir=FLAGS.logdir,
+        save_model_secs=FLAGS.save_model_secs,
+        max_to_keep=max_to_keep_from_flags(FLAGS),
+        background_save=background_save_from_flags(FLAGS),
+        sharded_spanning=bool(getattr(FLAGS, "sharded_checkpoint", True)),
+    )
+    logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
+                           job_name=FLAGS.job_name or "worker",
+                           task_index=FLAGS.task_index)
+    meter = Throughput(FLAGS.batch_size, n_chips)
+    last_display = {}
+    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
+    eval_every = max(0, getattr(FLAGS, "eval_step", 0))
+
+    with sv.managed(state) as box:
+        step = box.step
+        periodic_eval.prime(step)
+        pp_state = shard_state_pp(box.state, mesh)
+        compile_done = False
+        meter.reset()
+        while not sv.should_stop() and step < FLAGS.training_iter:
+            batch = ds.train.next_batch(FLAGS.batch_size)
+            pp_state, m = step_fn(pp_state, stage_batch_pp(mesh, batch))
+            step += 1
+            meter.step(FLAGS.batch_size)
+            if not compile_done:
+                jax.block_until_ready(pp_state.params)
+                meter.reset()
+                compile_done = True
+            boundary = (step % FLAGS.display_step == 0
+                        or (eval_every and step % eval_every == 0)
+                        or sv.checkpointer.cadence_due())
+            if boundary:
+                host = fetch_state_pp(pp_state, model)
+                box.update(host, step)
+                if step % FLAGS.display_step == 0:
+                    last_display = {k: float(v) for k, v in m.items()}
+                    logger.log_display(step, last_display["loss"],
+                                       last_display["accuracy"])
+                    logger.scalars(
+                        step, {"images_per_sec": meter.images_per_sec})
+                periodic_eval(host, step)
+                sv.maybe_checkpoint(host, step)
+        jax.block_until_ready(pp_state.params)
+        host = fetch_state_pp(pp_state, model)
+        box.update(host, step)
+
+    test_metrics = _final_test_eval(FLAGS, sv, periodic_eval, model, host,
+                                    ds, logger, step)
+    print("Optimization Finished!")
+    logger.close()
+    return TrainResult(
+        final_step=step,
+        train_metrics=last_display,
+        test_metrics=test_metrics,
+        images_per_sec=meter.images_per_sec,
+        images_per_sec_per_chip=meter.images_per_sec_per_chip,
+        n_chips=n_chips,
+    )
 
 
 def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,

@@ -1,0 +1,251 @@
+"""Pipeline parallelism (parallel/pipeline_parallel.py): the GPipe-style
+staged transformer must compute EXACTLY the function of running each
+microbatch through all blocks — trajectories pinned against the plain
+single-device step (which microbatching cannot change when grads are
+averaged: PP ≡ accumulation ≡ direct step for the same total batch)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.lm import LMDataSet
+from distributed_tensorflow_tpu.models.transformer import TransformerLM
+from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
+from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+    fetch_state_pp,
+    make_pp_train_step,
+    shard_state_pp,
+    stack_block_params,
+    stage_batch_pp,
+    unstack_block_params,
+)
+from distributed_tensorflow_tpu.training import (
+    create_train_state,
+    get_optimizer,
+    make_train_step,
+)
+
+
+KW = dict(vocab_size=16, seq_len=32, d_model=32, num_heads=2,
+          num_blocks=4)
+
+
+def test_stack_unstack_roundtrip():
+    model = TransformerLM(**KW)
+    params = model.init(jax.random.PRNGKey(0))
+    back = unstack_block_params(stack_block_params(params), 4)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("attn_block,ce_block", [(None, None), (8, 8)])
+def test_pp_trajectory_matches_single_device(attn_block, ce_block):
+    """K=4 stages x M=4 microbatches over a (data=2, model=4) mesh ==
+    the plain single-device step on the same batches (keep_prob=1.0 so
+    rng folds are moot; grads through the pipeline's ppermute
+    transposes must equal dense autodiff)."""
+    model = TransformerLM(**KW, attn_block=attn_block, ce_block=ce_block)
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(model, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+
+    single = create_train_state(model, opt, seed=0)
+    step1 = make_train_step(model, opt, keep_prob=1.0, donate=False)
+    pp_state = shard_state_pp(base, mesh)
+    stepP = make_pp_train_step(model, opt, mesh, microbatches=4,
+                               keep_prob=1.0, donate=False)
+
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=11)
+    for _ in range(3):
+        b = ds.next_batch(16)
+        single, m1 = step1(single, b)
+        pp_state, mP = stepP(pp_state, stage_batch_pp(mesh, b))
+    np.testing.assert_allclose(float(m1["loss"]), float(mP["loss"]),
+                               rtol=2e-5)
+    np.testing.assert_allclose(float(m1["accuracy"]),
+                               float(mP["accuracy"]), rtol=1e-6)
+    host = fetch_state_pp(pp_state, model)
+    for a, b_ in zip(jax.tree.leaves(single.params),
+                     jax.tree.leaves(host.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-5)
+    assert int(host.step) == 3
+
+
+def test_pp_state_actually_staged():
+    """The blocks really shard: each device holds num_blocks/K of the
+    stacked leading axis."""
+    model = TransformerLM(**KW)
+    opt = get_optimizer("sgd", 0.05)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    pp_state = shard_state_pp(create_train_state(model, opt, seed=0), mesh)
+    qkv = pp_state.params["blocks"]["qkv"]
+    assert qkv.shape[0] == 4  # stacked num_blocks
+    assert qkv.addressable_shards[0].data.shape[0] == 1  # 1 block/stage
+
+
+def test_pp_checkpoint_roundtrip_standard_layout():
+    """fetch_state_pp returns the STANDARD layout: a PP run's checkpoint
+    restores into a plain single-device state (cross-mode contract,
+    SURVEY.md §7 hard part d)."""
+    from distributed_tensorflow_tpu.checkpoint import (
+        restore_latest,
+        save_checkpoint,
+    )
+
+    model = TransformerLM(**KW)
+    opt = get_optimizer("sgd", 0.05)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    base = create_train_state(model, opt, seed=3)
+    pp_state = shard_state_pp(base, mesh)
+    stepP = make_pp_train_step(model, opt, mesh, microbatches=2,
+                               keep_prob=1.0, donate=False)
+    ds = LMDataSet(32, seq_len=32, vocab_size=16, seed=1)
+    pp_state, _ = stepP(pp_state, stage_batch_pp(mesh, ds.next_batch(8)))
+    host = fetch_state_pp(pp_state, model)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        save_checkpoint(d, host, step=1)
+        restored = restore_latest(d, create_train_state(model, opt, seed=9))
+        assert restored is not None and restored[1] == 1
+        for a, b in zip(jax.tree.leaves(host.params),
+                        jax.tree.leaves(restored[0].params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pp_rejections():
+    model_sp = TransformerLM(**KW, seq_axis="model")
+    opt = get_optimizer("sgd", 0.05)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    with pytest.raises(ValueError, match="does not compose"):
+        make_pp_train_step(model_sp, opt, mesh, microbatches=2)
+    model3 = TransformerLM(**{**KW, "num_blocks": 3})
+    with pytest.raises(ValueError, match="pipeline stages"):
+        make_pp_train_step(model3, opt, mesh, microbatches=2)
+
+
+def test_pipeline_cli_end_to_end(tmp_path):
+    """--pipeline through the production CLI: trains, checkpoints in
+    the STANDARD layout, resumes, finishes."""
+    import glob
+    import os
+
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    try:
+        flags.FLAGS._reset()
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+            "--dataset=lm", "--model=lm", "--pipeline", "--model_axis=4",
+            "--num_blocks=4", "--seq_len=32", "--vocab_size=16",
+            "--batch_size=16", "--training_iter=6", "--display_step=3",
+            "--test_eval=false",
+        ])
+        res = train(flags.FLAGS, mode="sync")
+        assert res.final_step == 6
+        assert np.isfinite(res.train_metrics["loss"])
+        assert glob.glob(os.path.join(str(tmp_path), "logs", "ckpt-*"))
+        # resume: the standard-layout checkpoint restores and stacking
+        # re-applies
+        flags.FLAGS._reset()
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+            "--dataset=lm", "--model=lm", "--pipeline", "--model_axis=4",
+            "--num_blocks=4", "--seq_len=32", "--vocab_size=16",
+            "--batch_size=16", "--training_iter=9", "--display_step=3",
+            "--test_eval=false",
+        ])
+        res2 = train(flags.FLAGS, mode="sync")
+        assert res2.final_step == 9
+    finally:
+        flags.FLAGS._reset()
+
+
+def test_pipeline_cli_rejections(tmp_path):
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+
+    def parse(*extra):
+        flags.FLAGS._reset()
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/l", f"--data_dir={tmp_path}/n",
+            "--dataset=lm", "--model=lm", "--pipeline",
+            "--seq_len=32", "--vocab_size=16", "--num_blocks=4",
+            "--batch_size=16", "--training_iter=2", *extra,
+        ])
+        return flags.FLAGS
+
+    try:
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            train(parse("--model_axis=4", "--seq_parallel"), mode="sync")
+        with pytest.raises(ValueError, match="stages nothing"):
+            train(parse(), mode="sync")
+        with pytest.raises(ValueError, match="not supported"):
+            train(parse("--model_axis=4", "--device_data"), mode="sync")
+        with pytest.raises(ValueError, match="redundant"):
+            train(parse("--model_axis=4", "--accum_steps=2"), mode="sync")
+    finally:
+        flags.FLAGS._reset()
+
+
+def test_pp_dropout_trajectory_matches_dp_accum():
+    """The module's dropout claim, pinned: PP with keep_prob<1 must
+    equal the sync-DP step with accum_steps=M on the same data mesh —
+    the three-way key derivation (split, DATA-axis fold, per-microbatch
+    fold) is identical by construction and must stay so."""
+    from distributed_tensorflow_tpu.parallel import make_dp_train_step
+    from distributed_tensorflow_tpu.parallel.data_parallel import (
+        replicate_state,
+        shard_batch,
+    )
+
+    model = TransformerLM(**KW)
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(model, opt, seed=0)
+    pp_mesh = make_mesh(MeshSpec(data=2, model=4))
+    dp_mesh = make_mesh(MeshSpec(data=2, model=1), jax.devices()[:2])
+
+    dp_state = replicate_state(dp_mesh, base)
+    dp_step = make_dp_train_step(model, opt, dp_mesh, keep_prob=0.5,
+                                 accum_steps=4, donate=False)
+    pp_state = shard_state_pp(base, pp_mesh)
+    pp_step = make_pp_train_step(model, opt, pp_mesh, microbatches=4,
+                                 keep_prob=0.5, donate=False)
+
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=13)
+    for _ in range(2):
+        b = ds.next_batch(16)
+        dp_state, mD = dp_step(dp_state, shard_batch(dp_mesh, b))
+        pp_state, mP = pp_step(pp_state, stage_batch_pp(pp_mesh, b))
+    np.testing.assert_allclose(float(mD["loss"]), float(mP["loss"]),
+                               rtol=2e-5)
+    host = fetch_state_pp(pp_state, model)
+    for a, b_ in zip(jax.tree.leaves(jax.device_get(dp_state.params)),
+                     jax.tree.leaves(host.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_pp_remat_matches_and_is_honored():
+    """--remat under PP: same trajectory (remat must not change math)
+    and the flag is actually honored (not silently dropped — the r5
+    review's finding)."""
+    model_r = TransformerLM(**KW, remat=True)
+    model_p = TransformerLM(**KW)
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(model_p, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    outs = []
+    for m in (model_p, model_r):
+        st = shard_state_pp(base, mesh)
+        stp = make_pp_train_step(m, opt, mesh, microbatches=2,
+                                 keep_prob=1.0, donate=False)
+        ds = LMDataSet(32, seq_len=32, vocab_size=16, seed=2)
+        st, metrics = stp(st, stage_batch_pp(mesh, ds.next_batch(8)))
+        outs.append(float(metrics["loss"]))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
