@@ -281,6 +281,21 @@ def define_reference_flags():
     DEFINE_integer("pp_microbatches", 0, "Microbatches per step under "
                    "--pipeline (0 = the stage count, the GPipe "
                    "default); must divide the per-data-shard batch")
+    DEFINE_integer("moe_experts", 0, "If > 0, the LM's MLPs become "
+                   "top-1 Switch mixture-of-experts layers with this "
+                   "many experts (ops/moe.py); the training loss adds "
+                   "--moe_aux times the load-balance term")
+    DEFINE_float("moe_capacity", 1.25, "Per-expert token capacity "
+                 "factor (tokens beyond ceil(cf*T/E) drop to the "
+                 "residual stream — Switch semantics)")
+    DEFINE_float("moe_aux", 0.01, "Load-balance auxiliary loss "
+                 "coefficient for --moe_experts")
+    DEFINE_boolean("expert_parallel", False, "Shard the MoE experts "
+                   "--model_axis ways over the mesh's 'model' axis "
+                   "(expert parallelism: every device routes "
+                   "identically, computes its experts' tokens, one "
+                   "psum combines — parallel/expert_parallel.py). "
+                   "Requires --moe_experts divisible by --model_axis")
     DEFINE_boolean("remat", False, "Rematerialize each transformer block "
                    "in the backward pass (jax.checkpoint): activation "
                    "memory drops to one block's worth at the cost of "

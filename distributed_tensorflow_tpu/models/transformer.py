@@ -45,9 +45,10 @@ def _layernorm(x, gain, bias, eps=1e-5):
     return (y * gain + bias).astype(x.dtype)
 
 
-def _block_params(w, d, h, dh, mlp_dim, dtype):
-    """One pre-LN block's parameter dict (shared by both transformer
-    families so their checkpoints stay structurally interchangeable)."""
+def _attn_half_params(w, d, h, dh, dtype):
+    """The attention half's parameters — ONE constructor for the dense
+    and MoE block forms (like _attn_half on the compute side), so the
+    layouts cannot diverge."""
     return {
         "ln1_g": jnp.ones((d,), dtype),
         "ln1_b": jnp.zeros((d,), dtype),
@@ -55,6 +56,14 @@ def _block_params(w, d, h, dh, mlp_dim, dtype):
         "proj": w((h * dh, d)),
         "ln2_g": jnp.ones((d,), dtype),
         "ln2_b": jnp.zeros((d,), dtype),
+    }
+
+
+def _block_params(w, d, h, dh, mlp_dim, dtype):
+    """One pre-LN block's parameter dict (shared by both transformer
+    families so their checkpoints stay structurally interchangeable)."""
+    return {
+        **_attn_half_params(w, d, h, dh, dtype),
         "mlp_in": {"w": w((d, mlp_dim)), "b": jnp.zeros((mlp_dim,), dtype)},
         "mlp_out": {"w": w((mlp_dim, d)), "b": jnp.zeros((d,), dtype)},
     }
@@ -66,16 +75,49 @@ def _transformer_block(h, blk, attn_fn, cd):
     flavor (dense / blockwise / ring, causal or not) so the block is the
     ONE implementation both model families and every parallelism mode
     run."""
-    y = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
-    qkv = jnp.einsum("bsd,dthe->tbshe", y, blk["qkv"].astype(y.dtype))
-    a = attn_fn(qkv[0], qkv[1], qkv[2])
-    a = a.reshape(*a.shape[:2], -1)  # (B, S, H*Dh)
-    h = h + nn.dense(a, blk["proj"], compute_dtype=cd)
+    h = _attn_half(h, blk, attn_fn, cd)
     y = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
     y = jax.nn.relu(nn.dense(y, blk["mlp_in"]["w"], blk["mlp_in"]["b"],
                              compute_dtype=cd))
     return h + nn.dense(y, blk["mlp_out"]["w"], blk["mlp_out"]["b"],
                         compute_dtype=cd)
+
+
+def _attn_half(h, blk, attn_fn, cd):
+    """LN -> attention -> residual (shared by the dense-MLP and MoE
+    block forms)."""
+    y = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
+    qkv = jnp.einsum("bsd,dthe->tbshe", y, blk["qkv"].astype(y.dtype))
+    a = attn_fn(qkv[0], qkv[1], qkv[2])
+    a = a.reshape(*a.shape[:2], -1)  # (B, S, H*Dh)
+    return h + nn.dense(a, blk["proj"], compute_dtype=cd)
+
+
+def _moe_block_params(w, d, h, dh, mlp_dim, num_experts, dtype):
+    """MoE block: same attention half as _block_params; the MLP becomes
+    E experts behind a top-1 router (ops/moe.py)."""
+    return {
+        **_attn_half_params(w, d, h, dh, dtype),
+        "moe": {
+            "router": w((d, num_experts)),
+            "w1": w((num_experts, d, mlp_dim)),
+            "b1": jnp.zeros((num_experts, mlp_dim), dtype),
+            "w2": w((num_experts, mlp_dim, d)),
+            "b2": jnp.zeros((num_experts, d), dtype),
+        },
+    }
+
+
+def _transformer_block_moe(h, blk, attn_fn, cd, capacity_factor,
+                           moe_axis):
+    """MoE block form: returns (h, load_balance_loss)."""
+    from distributed_tensorflow_tpu.ops.moe import switch_moe
+
+    h = _attn_half(h, blk, attn_fn, cd)
+    y = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
+    y, aux = switch_moe(y, blk["moe"], capacity_factor=capacity_factor,
+                        axis_name=moe_axis, compute_dtype=cd)
+    return h + y, aux["lb_loss"]
 
 
 @register_model("transformer")
@@ -246,6 +288,10 @@ class TransformerLM:
         attn_block: int | None = None,
         remat: bool = False,
         ce_block: int | None = None,
+        moe_experts: int = 0,
+        moe_capacity: float = 1.25,
+        moe_aux: float = 0.01,
+        moe_axis: str | None = None,
         **_unused,
     ):
         if d_model % num_heads:
@@ -254,6 +300,12 @@ class TransformerLM:
             raise ValueError("seq_axis (ring) and attn_block (local "
                              "blockwise) are mutually exclusive attention "
                              "flavors")
+        if moe_axis is not None and not moe_experts:
+            raise ValueError("moe_axis (expert parallelism) needs "
+                             "moe_experts > 0")
+        if moe_axis is not None and seq_axis is not None:
+            raise ValueError("moe_axis and seq_axis both claim the mesh's "
+                             "model axis — pick one")
         self.vocab_size = vocab_size
         self.seq_len = seq_len
         self.d_model = d_model
@@ -265,11 +317,15 @@ class TransformerLM:
         self.attn_block = attn_block
         self.remat = remat
         self.ce_block = ce_block
+        self.moe_experts = int(moe_experts)
+        self.moe_capacity = float(moe_capacity)
+        self.moe_aux = float(moe_aux)
+        self.moe_axis = moe_axis
 
     def init(self, key, dtype=jnp.float32):
         d, h = self.d_model, self.num_heads
         dh = d // h
-        keys = iter(jax.random.split(key, 4 + 7 * self.num_blocks))
+        keys = iter(jax.random.split(key, 4 + 8 * self.num_blocks))
 
         def w(shape, stddev=0.02):
             return truncated_normal_init(next(keys), shape, stddev, dtype)
@@ -285,8 +341,12 @@ class TransformerLM:
             },
         }
         for _ in range(self.num_blocks):
-            params["blocks"].append(
-                _block_params(w, d, h, dh, self.mlp_dim, dtype))
+            if self.moe_experts:
+                params["blocks"].append(_moe_block_params(
+                    w, d, h, dh, self.mlp_dim, self.moe_experts, dtype))
+            else:
+                params["blocks"].append(
+                    _block_params(w, d, h, dh, self.mlp_dim, dtype))
         return params
 
     def apply_hidden(self, params, x, *, keep_prob=1.0, rng=None,
@@ -295,6 +355,14 @@ class TransformerLM:
         hidden states (B, S, d) after ln_f + dropout. The streamed-CE
         path consumes this directly so the (B, S, V) logits never
         materialize; ``apply`` adds the head on top."""
+        return self._hidden_and_aux(params, x, keep_prob=keep_prob,
+                                    rng=rng, train=train)[0]
+
+    def _hidden_and_aux(self, params, x, *, keep_prob=1.0, rng=None,
+                        train: bool = False):
+        """(hidden, moe load-balance loss total) — the aux term is 0.0
+        for dense-MLP models; loss_with_metrics adds it to the training
+        loss scaled by ``moe_aux``."""
         cd = self.compute_dtype
         # x: integer ids (B, S) — or the LOCAL token block (B, S/P) when
         # called inside the SP shard_map step
@@ -317,12 +385,23 @@ class TransformerLM:
         else:
             attn = lambda q, k, v: multi_head_attention(q, k, v, causal=True)
 
-        blk_fn = _transformer_block
-        if self.remat:
-            blk_fn = jax.checkpoint(_transformer_block,
-                                    static_argnums=(2, 3))
-        for blk in params["blocks"]:
-            h = blk_fn(h, blk, attn, cd)
+        lb_total = jnp.float32(0.0)
+        if self.moe_experts:
+            moe_fn = _transformer_block_moe
+            if self.remat:
+                moe_fn = jax.checkpoint(_transformer_block_moe,
+                                        static_argnums=(2, 3, 4, 5))
+            for blk in params["blocks"]:
+                h, lb = moe_fn(h, blk, attn, cd, self.moe_capacity,
+                               self.moe_axis)
+                lb_total = lb_total + lb
+        else:
+            blk_fn = _transformer_block
+            if self.remat:
+                blk_fn = jax.checkpoint(_transformer_block,
+                                        static_argnums=(2, 3))
+            for blk in params["blocks"]:
+                h = blk_fn(h, blk, attn, cd)
 
         h = _layernorm(h, params["ln_f"]["g"], params["ln_f"]["b"])
         if rng is not None and self.seq_axis is not None:
@@ -330,7 +409,8 @@ class TransformerLM:
             # shards (each shard holds DIFFERENT tokens — unlike the
             # classifier's post-pool dropout, which must be identical)
             rng = jax.random.fold_in(rng, lax.axis_index(self.seq_axis))
-        return nn.dropout(h, keep_prob, rng, deterministic=not train)
+        return (nn.dropout(h, keep_prob, rng, deterministic=not train),
+                lb_total)
 
     def apply(self, params, x, *, keep_prob=1.0, rng=None, train: bool = False):
         h = self.apply_hidden(params, x, keep_prob=keep_prob, rng=rng,
@@ -339,18 +419,40 @@ class TransformerLM:
                           compute_dtype=self.compute_dtype)
         return logits.astype(jnp.float32)
 
+    @property
+    def wants_loss_hook(self) -> bool:
+        """True when training/eval must route through
+        ``loss_with_metrics`` (training.loss_and_metrics checks this):
+        the streamed CE head and/or the MoE auxiliary loss."""
+        return bool(self.ce_block or self.moe_experts)
+
     def loss_with_metrics(self, params, x, y, *, keep_prob=1.0, rng=None,
                           train: bool = False):
-        """(loss, {"loss", "accuracy"}) via the streamed head — the
-        train/eval hook ``training.loss_and_metrics`` routes through
-        when ``ce_block`` is set. Values/grads match apply +
-        softmax_cross_entropy to fp tolerance (tests/test_lm.py)."""
-        h = self.apply_hidden(params, x, keep_prob=keep_prob, rng=rng,
-                              train=train)
-        loss, acc = nn.streamed_softmax_ce_head(
-            h, params["head"]["w"], params["head"]["b"], y,
-            block=self.ce_block, compute_dtype=self.compute_dtype)
-        return loss, {"loss": loss, "accuracy": acc}
+        """(loss, metrics) — the train/eval hook. With ``ce_block`` the
+        CE is the streamed head (values/grads match apply +
+        softmax_cross_entropy to fp tolerance, tests/test_lm.py); with
+        ``moe_experts`` the TRAINING loss adds ``moe_aux`` times the
+        Switch load-balance term (metrics report it either way; eval
+        loss stays the plain CE)."""
+        h, lb = self._hidden_and_aux(params, x, keep_prob=keep_prob,
+                                     rng=rng, train=train)
+        if self.ce_block:
+            ce, acc = nn.streamed_softmax_ce_head(
+                h, params["head"]["w"], params["head"]["b"], y,
+                block=self.ce_block, compute_dtype=self.compute_dtype)
+        else:
+            logits = nn.dense(h, params["head"]["w"], params["head"]["b"],
+                              compute_dtype=self.compute_dtype)
+            logits = logits.astype(jnp.float32)
+            ce = nn.softmax_cross_entropy(logits, y)
+            acc = nn.accuracy(logits, y)
+        metrics = {"loss": ce, "accuracy": acc}
+        loss = ce
+        if self.moe_experts:
+            metrics["moe_lb"] = lb
+            if train:
+                loss = ce + self.moe_aux * lb
+        return loss, metrics
 
     def num_params(self, params=None):
         if params is None:

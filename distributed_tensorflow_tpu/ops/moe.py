@@ -1,0 +1,111 @@
+"""Switch-style top-1 mixture-of-experts MLP — the EP compute core.
+
+The reference has no MoE (SURVEY.md §2c: expert parallelism ABSENT);
+this is the build's fifth parallelism family, designed XLA-first: all
+static shapes, routing + dispatch as one-hot EINSUMS (the Switch
+Transformer formulation), no gather loops, so the MXU sees three big
+batched matmuls per expert group and the compiler fuses the rest.
+
+Routing: per token, softmax over E router logits, top-1 expert, the
+chosen probability as the gate. Capacity C = ceil(cf * T / E) tokens
+per expert — positions beyond C are DROPPED (the token's MoE output is
+zero; its residual stream passes through unchanged), which is what
+keeps every shape static. The load-balance auxiliary loss is the
+Switch one: E * sum_e(fraction_of_tokens_e * mean_router_prob_e),
+minimized at uniform routing; the model adds it to the training loss
+scaled by ``moe_aux``.
+
+EXPERT PARALLELISM: pass ``axis_name`` inside ``shard_map`` with the
+expert leaves sharded on their leading E axis — every device routes
+ALL tokens identically (router params replicated, h replicated over
+the axis), slices ITS experts' dispatch columns, computes only those,
+and one ``psum`` combines the partial outputs. Gradient accounting
+(the trap family sequence_parallel/pipeline_parallel document): the
+caller differentiates loss/P per device; the psum transpose then
+delivers UNSCALED cotangents, so expert-shard grads are exact partials
+(no reduction) and replicated-leaf grads total under one psum over the
+axis — parallel/expert_parallel.py owns that derivation; this op just
+takes the axis.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_capacity(tokens: int, num_experts: int,
+                 capacity_factor: float) -> int:
+    """Static per-expert token capacity (>=1)."""
+    return max(1, math.ceil(capacity_factor * tokens / num_experts))
+
+
+def switch_moe(h, params, *, capacity_factor: float = 1.25,
+               axis_name: str | None = None, compute_dtype=None):
+    """(B, S, d) -> ((B, S, d), aux_dict).
+
+    ``params``: {"router": (d, E), "w1": (E, d, m), "b1": (E, m),
+    "w2": (E, m, d), "b2": (E, d)} — under ``axis_name`` the expert
+    leaves are the LOCAL (E/P, ...) shards. ``aux``: {"lb_loss"
+    (scalar, identical on every device), "dropped_frac"}."""
+    b, s, d = h.shape
+    t = b * s
+    hf = h.reshape(t, d)
+    cd = compute_dtype
+    router = params["router"]
+    e_local = params["w1"].shape[0]
+    if axis_name is None:
+        e_total = e_local
+        e_start = 0
+    else:
+        e_total = e_local * lax.axis_size(axis_name)
+        e_start = lax.axis_index(axis_name) * e_local
+    cap = moe_capacity(t, e_total, capacity_factor)
+
+    # routing in f32 — identical on every device (replicated inputs)
+    logits = jnp.dot(hf.astype(jnp.float32), router.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)          # (T, E)
+    expert = jnp.argmax(probs, axis=-1)              # (T,)
+    gate = jnp.max(probs, axis=-1)                   # (T,)
+    assign = jax.nn.one_hot(expert, e_total, dtype=jnp.float32)
+    # 1-based arrival position of each token in its expert's queue;
+    # tokens past the capacity are dropped (static shapes)
+    pos = jnp.cumsum(assign, axis=0) * assign        # (T, E)
+    keep = assign * (pos <= cap)
+    slot = jax.nn.one_hot((pos - 1.0).astype(jnp.int32), cap,
+                          dtype=jnp.float32) * keep[..., None]  # (T,E,C)
+
+    # load balance (Switch): E * sum_e f_e * p_e — from the FULL
+    # assignment, so it is identical on every device
+    f_e = jnp.mean(assign, axis=0)
+    p_e = jnp.mean(probs, axis=0)
+    lb_loss = e_total * jnp.sum(f_e * p_e)
+    dropped = 1.0 - jnp.sum(keep) / jnp.maximum(jnp.sum(assign), 1.0)
+
+    # this device's experts only
+    local = lax.dynamic_slice_in_dim(slot, e_start, e_local, axis=1)
+    if cd is not None:
+        xe = jnp.einsum("tec,td->ecd", local.astype(cd), hf.astype(cd))
+        he = jax.nn.relu(
+            jnp.einsum("ecd,edm->ecm", xe, params["w1"].astype(cd))
+            + params["b1"].astype(cd)[:, None, :])
+        ye = (jnp.einsum("ecm,emd->ecd", he, params["w2"].astype(cd))
+              + params["b2"].astype(cd)[:, None, :])
+        comb = (local * gate[:, None, None]).astype(cd)
+        y = jnp.einsum("tec,ecd->td", comb, ye).astype(h.dtype)
+    else:
+        xe = jnp.einsum("tec,td->ecd", local, hf)
+        he = jax.nn.relu(
+            jnp.einsum("ecd,edm->ecm", xe, params["w1"])
+            + params["b1"][:, None, :])
+        ye = (jnp.einsum("ecm,emd->ecd", he, params["w2"])
+              + params["b2"][:, None, :])
+        y = jnp.einsum("tec,ecd->td", local * gate[:, None, None], ye)
+        y = y.astype(h.dtype)
+    if axis_name is not None:
+        y = lax.psum(y, axis_name)
+    return y.reshape(b, s, d), {"lb_loss": lb_loss,
+                                "dropped_frac": dropped}

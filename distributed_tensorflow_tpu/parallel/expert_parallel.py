@@ -1,0 +1,174 @@
+"""Expert parallelism: MoE experts sharded over the "model" axis.
+
+The fifth parallelism family (dp/tp/pp/sp/ep — SURVEY.md §2c lists the
+last four ABSENT from the reference; the mesh's open "model" axis hosts
+them all). Layout: batch over "data", EXPERTS over "model" — every
+device holds its E/P experts' weights (the leading E axis of the moe
+leaves), routes ALL of its data shard's tokens identically (router
+replicated, routing deterministic), computes only the dispatch columns
+of ITS experts, and one ``psum`` inside ``ops/moe.switch_moe`` combines
+the partial outputs. No all-to-all needed at this formulation's scale:
+token activations are replicated over the expert axis, so the psum IS
+the combine.
+
+Gradient derivation (cf. sequence_parallel's two and
+pipeline_parallel's): the per-device loss is computed from the psum'd
+combine, i.e. every expert-axis device holds a REPLICATED copy. Seeding
+each copy with cotangent 1.0 would make psum's transpose (another psum)
+deliver P-scaled cotangents to the expert paths — so the step
+differentiates ``loss / P`` instead: the psum of the 1/P seeds is
+exactly 1.0, expert-shard gradients come out as EXACT partials (no
+cross-device reduction — they are different experts), and the
+replicated leaves' per-device partials (each 1/P of its copy's share)
+total under one ``psum`` over the axis. Then the usual pmean over
+"data". Exactness is pinned the only way that matters: EP trajectory ==
+the identical MoE model on one device (tests/test_moe.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from distributed_tensorflow_tpu.training.train_state import (
+    TrainState,
+    apply_updates,
+)
+
+_EXPERT_LEAVES = ("w1", "b1", "w2", "b2")
+
+
+def _is_expert_leaf(path) -> bool:
+    keys = tuple(getattr(p, "key", getattr(p, "idx", None)) for p in path)
+    return "moe" in keys and keys[-1] in _EXPERT_LEAVES
+
+
+def ep_state_specs(state: TrainState) -> TrainState:
+    """PartitionSpec pytree: expert leaves split on their leading E axis
+    over "model", everything else replicated; optimizer slots follow
+    their params (structure-matched)."""
+    def spec(path, _leaf):
+        return P(MODEL_AXIS) if _is_expert_leaf(path) else P()
+
+    pspecs = jax.tree_util.tree_map_with_path(spec, state.params)
+    pstruct = jax.tree.structure(state.params)
+    pleaves = jax.tree.leaves(pspecs, is_leaf=lambda v: isinstance(v, P))
+
+    def opt_specs(entry):
+        if jax.tree.structure(entry) == pstruct:
+            return jax.tree.unflatten(pstruct, pleaves)
+        if isinstance(entry, dict):
+            return {k: opt_specs(v) for k, v in entry.items()}
+        return jax.tree.map(lambda _: P(), entry)
+
+    return TrainState(params=pspecs, opt_state=opt_specs(state.opt_state),
+                      step=P(), rng=P(),
+                      model_state=jax.tree.map(lambda _: P(),
+                                               state.model_state))
+
+
+def shard_state_ep(state: TrainState, mesh) -> TrainState:
+    """Place a host-built MoE TrainState with the EP layout. The pytree
+    LAYOUT is the standard one (checkpoints need no conversion —
+    single-process EP leaves stay fully addressable)."""
+    specs = ep_state_specs(state)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda v: isinstance(v, P))
+    return jax.device_put(state, shardings)
+
+
+def make_ep_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
+                       donate: bool = True, grad_transform=None):
+    """Compiled expert-parallel train step: (EP-layout state, staged
+    batch) -> (state, metrics). ``model`` must carry
+    ``moe_axis=MODEL_AXIS`` (its switch_moe then slices local experts
+    and psums the combine) and ``moe_experts`` divisible by the axis."""
+    if getattr(model, "moe_axis", None) != MODEL_AXIS:
+        raise ValueError(
+            f"model.moe_axis must be {MODEL_AXIS!r} for the EP step "
+            f"(got {getattr(model, 'moe_axis', None)!r})")
+    ways = mesh.shape[MODEL_AXIS]
+    if model.moe_experts % ways:
+        raise ValueError(f"moe_experts={model.moe_experts} must divide "
+                         f"over the {ways}-way expert axis")
+
+    def per_shard(state: TrainState, batch):
+        x, y = batch
+        rng, sub = jax.random.split(state.rng)
+        # dropout keys fold the DATA index only: expert-axis devices
+        # must apply IDENTICAL masks (the replicated-activation
+        # invariant the psum-combine rests on)
+        sub = jax.random.fold_in(sub, lax.axis_index(DATA_AXIS))
+        inv_p = 1.0 / ways
+
+        def loss_fn(params):
+            loss, metrics = model.loss_with_metrics(
+                params, x, y, keep_prob=keep_prob, rng=sub, train=True)
+            # the 1/P seed — see the module docstring's derivation
+            return loss * inv_p, metrics
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+
+        def reduce_g(path, g):
+            if _is_expert_leaf(path):
+                return g  # exact partial of a distinct shard
+            return lax.psum(g, MODEL_AXIS)
+
+        grads = jax.tree_util.tree_map_with_path(reduce_g, grads)
+        grads = jax.tree.map(lambda g: lax.pmean(g, DATA_AXIS), grads)
+        if grad_transform is not None:
+            grads = grad_transform(grads)
+        metrics = jax.tree.map(lambda v: lax.pmean(v, DATA_AXIS), metrics)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params, state.step)
+        params = apply_updates(state.params, updates)
+        return (TrainState(params, opt_state, state.step + 1, rng,
+                           state.model_state), metrics)
+
+    data_spec = (P(DATA_AXIS, None), P(DATA_AXIS, None))
+    cache: dict = {}
+
+    def call(state, batch):
+        fn = cache.get("fn")
+        if fn is None:
+            sharded = jax.shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(ep_state_specs(state), data_spec),
+                out_specs=(ep_state_specs(state), P()),
+                check_vma=False)
+            fn = cache["fn"] = jax.jit(
+                sharded, donate_argnums=(0,) if donate else ())
+        return fn(state, batch)
+
+    return call
+
+
+def make_ep_eval_step(model, mesh):
+    """Dropout-off EP metrics (same layout; loss is the plain CE)."""
+    if getattr(model, "moe_axis", None) != MODEL_AXIS:
+        raise ValueError("model.moe_axis must be set for the EP eval")
+
+    def per_shard(params, batch):
+        x, y = batch
+        _, metrics = model.loss_with_metrics(params, x, y, train=False)
+        return jax.tree.map(lambda v: lax.pmean(v, DATA_AXIS), metrics)
+
+    data_spec = (P(DATA_AXIS, None), P(DATA_AXIS, None))
+    cache: dict = {}
+
+    def eval_step(params, batch, model_state=()):
+        fn = cache.get("fn")
+        if fn is None:
+            pspecs = jax.tree_util.tree_map_with_path(
+                lambda path, _: (P(MODEL_AXIS) if _is_expert_leaf(path)
+                                 else P()),
+                params)
+            fn = cache["fn"] = jax.jit(jax.shard_map(
+                per_shard, mesh=mesh, in_specs=(pspecs, data_spec),
+                out_specs=P(), check_vma=False))
+        return fn(params, batch)
+
+    return eval_step

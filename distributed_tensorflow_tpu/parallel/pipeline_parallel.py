@@ -188,6 +188,11 @@ def make_pp_train_step(model, optimizer, mesh, microbatches: int,
         raise ValueError("pipeline parallelism stages BLOCKS; it does "
                          "not compose with seq_axis (ring attention) — "
                          "pick one model-axis strategy")
+    if getattr(model, "moe_experts", 0):
+        raise ValueError("pipeline parallelism is not wired for MoE "
+                         "blocks (the stage scan runs the dense block "
+                         "form and would drop the aux loss); use "
+                         "--expert_parallel for MoE sharding")
     k_stages = mesh.shape[MODEL_AXIS]
     if model.num_blocks % k_stages:
         raise ValueError(

@@ -88,6 +88,9 @@ def build_model_for(FLAGS, meta: dict):
             attn_block=attn_block if attn_block > 0 else None,
             remat=bool(getattr(FLAGS, "remat", False)),
             ce_block=ce_block if ce_block > 0 else None,
+            moe_experts=int(getattr(FLAGS, "moe_experts", 0)),
+            moe_capacity=float(getattr(FLAGS, "moe_capacity", 1.25)),
+            moe_aux=float(getattr(FLAGS, "moe_aux", 0.01)),
         )
     if FLAGS.model == "lm":
         raise ValueError("--model lm consumes token sequences; use "
@@ -193,14 +196,86 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 f"--accum_steps={accum}"
             )
     if getattr(FLAGS, "pipeline", False):
-        if getattr(FLAGS, "seq_parallel", False):
-            raise ValueError("--pipeline (staged blocks) and "
-                             "--seq_parallel (token sharding) are "
-                             "mutually exclusive model-axis strategies")
+        if getattr(FLAGS, "seq_parallel", False) or \
+                getattr(FLAGS, "expert_parallel", False):
+            raise ValueError("--pipeline, --seq_parallel and "
+                             "--expert_parallel are mutually exclusive "
+                             "model-axis strategies — pick one")
         return _train_pipeline(FLAGS, ds, model, opt, state, mode,
                                model_axis, clip)
     sp_device_model = None  # set by the SP branch for --device_data
-    if getattr(FLAGS, "seq_parallel", False):
+    if getattr(FLAGS, "expert_parallel", False):
+        # expert parallelism: MoE experts sharded --model_axis ways
+        # (parallel/expert_parallel.py); the EP twin carries moe_axis
+        # and the step/eval builders slot into the common loop like
+        # SP's do
+        from distributed_tensorflow_tpu.models.transformer import (
+            TransformerLM,
+        )
+        from distributed_tensorflow_tpu.parallel import MeshSpec
+        from distributed_tensorflow_tpu.parallel.expert_parallel import (
+            make_ep_eval_step,
+            make_ep_train_step,
+            shard_state_ep,
+        )
+        from distributed_tensorflow_tpu.parallel.mesh import (
+            DATA_AXIS,
+            MODEL_AXIS,
+            put_global,
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if not (is_lm and getattr(model, "moe_experts", 0)):
+            raise ValueError("--expert_parallel shards MoE experts; use "
+                             "--model lm --dataset lm --moe_experts E")
+        if mode != "sync":
+            raise ValueError("--expert_parallel requires sync mode")
+        if model_axis < 2:
+            raise ValueError(f"--expert_parallel shards experts "
+                             f"--model_axis ways; --model_axis="
+                             f"{model_axis} shards nothing")
+        if jax.process_count() > 1:
+            raise ValueError("--expert_parallel is single-process in "
+                             "this version")
+        if getattr(FLAGS, "seq_parallel", False):
+            # (--pipeline already raised or returned in its own branch)
+            raise ValueError("--expert_parallel, --seq_parallel and "
+                             "--pipeline each claim the model axis — "
+                             "pick one")
+        if getattr(FLAGS, "device_data", False):
+            raise ValueError("--device_data is not wired for "
+                             "--expert_parallel yet")
+        if accum > 1:
+            raise ValueError("--accum_steps is not wired for "
+                             "--expert_parallel yet; raise --batch_size "
+                             "instead")
+        ep_model = TransformerLM(
+            vocab_size=model.vocab_size, seq_len=model.seq_len,
+            d_model=model.d_model, num_heads=model.num_heads,
+            num_blocks=model.num_blocks,
+            mlp_ratio=model.mlp_dim // model.d_model,
+            compute_dtype=model.compute_dtype,
+            attn_block=model.attn_block, remat=model.remat,
+            ce_block=model.ce_block, moe_experts=model.moe_experts,
+            moe_capacity=model.moe_capacity, moe_aux=model.moe_aux,
+            moe_axis=MODEL_AXIS)
+        mesh = make_mesh(MeshSpec(data=-1, model=model_axis))
+        n_chips = mesh.devices.size
+        data_ways = mesh.shape[DATA_AXIS]
+        if FLAGS.batch_size % data_ways:
+            raise ValueError(
+                f"--batch_size={FLAGS.batch_size} must be divisible by "
+                f"the {data_ways}-way data axis")
+        state = shard_state_ep(state, mesh)
+        step_fn = make_ep_train_step(ep_model, opt, mesh,
+                                     keep_prob=FLAGS.keep_prob,
+                                     grad_transform=clip)
+        eval_fn = make_ep_eval_step(ep_model, mesh)
+        _ep_specs = (NamedSharding(mesh, P(DATA_AXIS, None)),
+                     NamedSharding(mesh, P(DATA_AXIS, None)))
+        stage = lambda b: put_global(_ep_specs, b)
+        restage = lambda s: shard_state_ep(s, mesh)
+    elif getattr(FLAGS, "seq_parallel", False):
         # sequence/context parallelism: tokens sharded --model_axis ways,
         # ring attention over the mesh's "model" axis
         # (parallel/sequence_parallel.py). The training step runs an
@@ -230,6 +305,12 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 f"--seq_parallel requires --model transformer or lm (an "
                 f"attention model with a token axis to shard); got "
                 f"--model {FLAGS.model!r}")
+        if getattr(model, "moe_experts", 0):
+            raise ValueError(
+                "--moe_experts with --seq_parallel is not supported: "
+                "token-sharded MoE routing (each shard routing its own "
+                "tokens) is a different design than the expert-sharded "
+                "--expert_parallel; pick one model-axis strategy")
         if mode != "sync":
             raise ValueError(
                 "--seq_parallel requires sync mode (a device mesh); "

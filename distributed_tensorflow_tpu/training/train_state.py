@@ -222,10 +222,11 @@ def loss_and_metrics(model, params, batch, *, keep_prob=1.0, rng=None,
     has_aux channel so the compiled step threads it into the next
     TrainState without a second forward pass."""
     x, y = batch
-    if getattr(model, "ce_block", None):
-        # streamed-loss models (TransformerLM ce_block): the model owns
-        # the loss so the (B, S, V) logits never materialize — one hook
-        # covers train, eval (make_eval_step) and evaluate()
+    if getattr(model, "wants_loss_hook", False):
+        # models owning their loss (TransformerLM ce_block: streamed CE
+        # so the (B, S, V) logits never materialize; moe_experts: the
+        # load-balance aux term) — one hook covers train, eval
+        # (make_eval_step) and evaluate()
         loss, metrics = model.loss_with_metrics(
             params, x, y, keep_prob=keep_prob, rng=rng, train=train)
         return loss, {"metrics": metrics, "model_state": model_state}

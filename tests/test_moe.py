@@ -1,0 +1,337 @@
+"""Switch MoE (ops/moe.py) + expert parallelism
+(parallel/expert_parallel.py): routing/capacity semantics, the aux
+loss, and the EP trajectory == the IDENTICAL MoE model on one device —
+the only exactness standard a sparse layer has (there is no dense
+twin)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.lm import LMDataSet
+from distributed_tensorflow_tpu.models.transformer import TransformerLM
+from distributed_tensorflow_tpu.ops.moe import moe_capacity, switch_moe
+from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
+from distributed_tensorflow_tpu.parallel.expert_parallel import (
+    make_ep_eval_step,
+    make_ep_train_step,
+    shard_state_ep,
+)
+from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
+from distributed_tensorflow_tpu.training import (
+    create_train_state,
+    get_optimizer,
+    make_train_step,
+)
+
+MOE_KW = dict(vocab_size=16, seq_len=32, d_model=32, num_heads=2,
+              num_blocks=2, moe_experts=4)
+
+
+def _moe_params(key, d=8, e=4, m=16):
+    k = iter(jax.random.split(key, 5))
+    return {
+        "router": jax.random.normal(next(k), (d, e)) * 0.3,
+        "w1": jax.random.normal(next(k), (e, d, m)) * 0.3,
+        "b1": jnp.zeros((e, m)),
+        "w2": jax.random.normal(next(k), (e, m, d)) * 0.3,
+        "b2": jnp.zeros((e, d)),
+    }
+
+
+def test_capacity_math():
+    assert moe_capacity(64, 4, 1.0) == 16
+    assert moe_capacity(64, 4, 1.25) == 20
+    assert moe_capacity(3, 8, 1.0) == 1  # floor of one slot
+
+
+def test_switch_moe_routes_to_argmax_expert():
+    """With generous capacity, each token's output must equal
+    gate * MLP_{argmax expert}(token) — the top-1 semantics."""
+    params = _moe_params(jax.random.PRNGKey(0))
+    h = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8))
+    y, aux = switch_moe(h, params, capacity_factor=8.0)
+    hf = h.reshape(-1, 8)
+    logits = hf @ params["router"]
+    probs = jax.nn.softmax(logits, -1)
+    e = jnp.argmax(probs, -1)
+    gate = jnp.max(probs, -1)
+    for t in range(hf.shape[0]):
+        ei = int(e[t])
+        ref = jax.nn.relu(hf[t] @ params["w1"][ei] + params["b1"][ei])
+        ref = (ref @ params["w2"][ei] + params["b2"][ei]) * gate[t]
+        np.testing.assert_allclose(np.asarray(y.reshape(-1, 8)[t]),
+                                   np.asarray(ref), rtol=1e-5, atol=1e-5)
+    assert float(aux["dropped_frac"]) == 0.0
+    assert np.isfinite(float(aux["lb_loss"]))
+
+
+def test_switch_moe_capacity_drops_overflow():
+    """Tokens past an expert's capacity contribute zero output (the
+    residual stream carries them) and the dropped fraction reports."""
+    params = _moe_params(jax.random.PRNGKey(0))
+    # route EVERY token to one expert: all-positive tokens against a
+    # hard-biased router column (h @ router must win for expert 2
+    # regardless of draw, so keep h positive)
+    params["router"] = jnp.zeros((8, 4)).at[:, 2].set(100.0)
+    h = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (1, 16, 8))) + 0.1
+    y, aux = switch_moe(h, params, capacity_factor=1.0)
+    # capacity = ceil(16/4) = 4 -> 12 of 16 dropped
+    assert float(aux["dropped_frac"]) == pytest.approx(0.75)
+    flat = np.asarray(y.reshape(16, 8))
+    assert np.count_nonzero(np.abs(flat).sum(-1) > 1e-12) == 4
+
+
+def test_moe_lm_trains_and_aux_loss_flows():
+    """A MoE TransformerLM trains through the STANDARD step machinery
+    (the loss hook adds the aux term in train mode only) and the lb
+    metric reports near its uniform-routing floor of 1.0."""
+    model = TransformerLM(**MOE_KW)
+    opt = get_optimizer("adam", 3e-3)
+    state = create_train_state(model, opt, seed=0)
+    step = make_train_step(model, opt, keep_prob=1.0)
+    ds = LMDataSet(32, seq_len=32, vocab_size=16, seed=0)
+    first = None
+    for _ in range(20):
+        state, m = step(state, ds.next_batch(8))
+        if first is None:
+            first = float(m["loss"])
+    assert float(m["loss"]) < first
+    assert float(m["moe_lb"]) >= 0.99  # >= 1.0 up to fp noise
+
+
+# NOTE on exactness scope: capacity queues and the load-balance term
+# are computed per ROUTING GROUP (= one data shard's tokens) — standard
+# Switch semantics, so batch grouping changes which overflow tokens
+# drop and the lb statistics. The exact-equality tests therefore use
+# data=1 (one group, aux on) and a no-drop capacity; the DP composition
+# is pinned separately with the aux coefficient zeroed.
+
+
+def test_ep_trajectory_matches_single_device():
+    """The EP standard: experts sharded 4 ways over (data=1, model=4)
+    == the identical MoE model on one device, trajectories to fp
+    tolerance (routing identical, psum-combine exact, the 1/P-seed
+    gradient accounting correct, aux loss included)."""
+    kw = dict(MOE_KW, moe_capacity=8.0)
+    model1 = TransformerLM(**kw)
+    modelP = TransformerLM(**kw, moe_axis=MODEL_AXIS)
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(model1, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=1, model=4), jax.devices()[:4])
+
+    single = create_train_state(model1, opt, seed=0)
+    step1 = make_train_step(model1, opt, keep_prob=1.0, donate=False)
+    ep_state = shard_state_ep(base, mesh)
+    stepP = make_ep_train_step(modelP, opt, mesh, keep_prob=1.0,
+                               donate=False)
+
+    from distributed_tensorflow_tpu.parallel.expert_parallel import (
+        ep_state_specs,
+    )
+    from distributed_tensorflow_tpu.parallel.mesh import put_global
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def stage(b):
+        return put_global(
+            (NamedSharding(mesh, P("data", None)),
+             NamedSharding(mesh, P("data", None))),
+            (jnp.asarray(b[0]), jnp.asarray(b[1])))
+
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=17)
+    # ONE step pinned TIGHT: at identical params the routing is
+    # identical, so any gradient-accounting error (e.g. the P-scaled
+    # psum-transpose seeds) shows as a 4x grad error here. Later steps
+    # cannot be pinned tightly — top-1 argmax amplifies f32
+    # summation-order ulps into discrete routing flips at decision
+    # boundaries (inherent to sparse routing, not an EP defect).
+    for _ in range(3):
+        b = ds.next_batch(8)
+        single, m1 = step1(single, b)
+        ep_state, mP = stepP(ep_state, stage(b))
+    np.testing.assert_allclose(float(m1["loss"]), float(mP["loss"]),
+                               rtol=2e-4)
+    np.testing.assert_allclose(float(m1["moe_lb"]), float(mP["moe_lb"]),
+                               rtol=2e-4)
+    for a, b_ in zip(jax.tree.leaves(single.params),
+                     jax.tree.leaves(jax.device_get(ep_state.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-4, atol=3e-5)
+    # the experts really shard: leading E axis 4 -> 1 per device
+    w1 = ep_state.params["blocks"][0]["moe"]["w1"]
+    assert w1.addressable_shards[0].data.shape[0] == 1
+
+    ev = make_ep_eval_step(modelP, mesh)
+    b = ds.next_batch(8)
+    staged = put_global(
+        (NamedSharding(mesh, P("data", None)),
+         NamedSharding(mesh, P("data", None))),
+        (jnp.asarray(b[0]), jnp.asarray(b[1])))
+    m = ev(ep_state.params, staged)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_moe_guards():
+    with pytest.raises(ValueError, match="needs moe_experts"):
+        TransformerLM(vocab_size=16, seq_len=32, d_model=32, num_heads=2,
+                      moe_axis=MODEL_AXIS)
+    model = TransformerLM(**MOE_KW, moe_axis=MODEL_AXIS)
+    opt = get_optimizer("sgd", 0.05)
+    mesh3 = make_mesh(MeshSpec(data=1, model=8))
+    with pytest.raises(ValueError, match="must divide"):
+        make_ep_train_step(model, opt, mesh3)  # 4 experts over 8 ways
+
+
+def test_ep_composes_with_dp():
+    """EP x DP over (data=2, model=4): the per-group routing semantics
+    make exact equality vs single-device hold when the aux coefficient
+    is zero and capacity never drops (each data shard is its own
+    routing group — the documented Switch grouping)."""
+    kw = dict(MOE_KW, moe_capacity=8.0, moe_aux=0.0)
+    model1 = TransformerLM(**kw)
+    modelP = TransformerLM(**kw, moe_axis=MODEL_AXIS)
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(model1, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+
+    from distributed_tensorflow_tpu.parallel.mesh import put_global
+    from distributed_tensorflow_tpu.training.train_state import (
+        compute_grads,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ep_state = shard_state_ep(base, mesh)
+    stepP = make_ep_train_step(modelP, opt, mesh, keep_prob=1.0,
+                               donate=False)
+    # manual reference: the DP semantics with per-shard routing groups —
+    # grads averaged over the two half-batches (each routed alone)
+    from distributed_tensorflow_tpu.training.train_state import (
+        apply_updates,
+    )
+
+    state = create_train_state(model1, opt, seed=0)
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=19)
+    for _ in range(2):
+        x, y = ds.next_batch(8)
+        halves = [(x[:4], y[:4]), (x[4:], y[4:])]
+        gs = []
+        for hb in halves:
+            g, m, _ = compute_grads(model1, state.params, hb,
+                                    keep_prob=1.0, rng=None,
+                                    model_state=())
+            gs.append(g)
+        g = jax.tree.map(lambda a, b: (a + b) / 2, *gs)
+        updates, opt_state = opt.update(g, state.opt_state, state.params,
+                                        state.step)
+        state = state._replace(
+            params=apply_updates(state.params, updates),
+            opt_state=opt_state, step=state.step + 1)
+        staged = put_global(
+            (NamedSharding(mesh, P("data", None)),
+             NamedSharding(mesh, P("data", None))),
+            (jnp.asarray(x), jnp.asarray(y)))
+        ep_state, mP = stepP(ep_state, staged)
+    for a, b_ in zip(jax.tree.leaves(state.params),
+                     jax.tree.leaves(jax.device_get(ep_state.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_expert_parallel_cli_end_to_end(tmp_path):
+    """--expert_parallel through the production CLI: trains,
+    checkpoints, resumes."""
+    import glob
+
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    try:
+        flags.FLAGS._reset()
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+            "--dataset=lm", "--model=lm", "--moe_experts=4",
+            "--expert_parallel", "--model_axis=4", "--seq_len=32",
+            "--vocab_size=16", "--batch_size=8", "--training_iter=6",
+            "--display_step=3", "--test_eval=false",
+        ])
+        res = train(flags.FLAGS, mode="sync")
+        assert res.final_step == 6
+        assert np.isfinite(res.train_metrics["loss"])
+        assert glob.glob(f"{tmp_path}/logs/ckpt-*")
+        flags.FLAGS._reset()
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+            "--dataset=lm", "--model=lm", "--moe_experts=4",
+            "--expert_parallel", "--model_axis=4", "--seq_len=32",
+            "--vocab_size=16", "--batch_size=8", "--training_iter=9",
+            "--display_step=3", "--test_eval=false",
+        ])
+        assert train(flags.FLAGS, mode="sync").final_step == 9
+    finally:
+        flags.FLAGS._reset()
+
+
+def test_expert_parallel_cli_rejections(tmp_path):
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+
+    def parse(*extra):
+        flags.FLAGS._reset()
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/l", f"--data_dir={tmp_path}/n",
+            "--dataset=lm", "--model=lm", "--seq_len=32",
+            "--vocab_size=16", "--batch_size=8", "--training_iter=2",
+            *extra,
+        ])
+        return flags.FLAGS
+
+    try:
+        with pytest.raises(ValueError, match="shards MoE experts"):
+            train(parse("--expert_parallel", "--model_axis=4"),
+                  mode="sync")
+        with pytest.raises(ValueError, match="pick one"):
+            train(parse("--expert_parallel", "--moe_experts=4",
+                        "--model_axis=4", "--seq_parallel"), mode="sync")
+        with pytest.raises(ValueError, match="shards nothing"):
+            train(parse("--expert_parallel", "--moe_experts=4"),
+                  mode="sync")
+    finally:
+        flags.FLAGS._reset()
+
+
+def test_moe_excluded_from_sp_and_pp():
+    """MoE + the other model-axis strategies fail LOUDLY (not with a
+    KeyError mid-trace): SP twin construction and the PP builder both
+    reject MoE params up front."""
+    from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+        make_pp_train_step,
+    )
+
+    model = TransformerLM(**MOE_KW)
+    opt = get_optimizer("sgd", 0.05)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    with pytest.raises(ValueError, match="not wired for MoE"):
+        make_pp_train_step(model, opt, mesh, microbatches=2)
+
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+    import tempfile
+
+    flags.define_reference_flags()
+    with tempfile.TemporaryDirectory() as d:
+        try:
+            flags.FLAGS._reset()
+            flags.FLAGS._parse([
+                f"--logdir={d}/l", f"--data_dir={d}/n", "--dataset=lm",
+                "--model=lm", "--moe_experts=4", "--seq_parallel",
+                "--model_axis=4", "--seq_len=32", "--vocab_size=16",
+                "--batch_size=8", "--training_iter=2",
+            ])
+            with pytest.raises(ValueError, match="not supported"):
+                train(flags.FLAGS, mode="sync")
+        finally:
+            flags.FLAGS._reset()
