@@ -118,6 +118,21 @@ LM_BIGV_BATCH = 4
 LM_BIGV_CE_BLOCK = 512
 LM_BIGV_TIMED_STEPS = 10
 
+# PP/EP device-resident phases (r6): the two newest parallel modes
+# composed with the headline input path — split resident in HBM, batch
+# sampled on device inside shard_map, lax.scan chunking. Needs a model
+# axis: skipped (null fields) on a 1-chip machine; the 2-way split is
+# the fallback so a v4-8's 4-way axis and a 2-chip donor both measure.
+PP_EP_SEQ_LEN = 128
+PP_EP_VOCAB = 64
+PP_EP_D_MODEL = 128
+PP_EP_NUM_BLOCKS = 4
+PP_EP_SPLIT = 2048           # resident sequences staged per phase
+PP_EP_BATCH_PER_DATA_WAY = 16
+PP_EP_CHUNK = 10
+PP_EP_TIMED_CHUNKS = 3
+PP_EP_EXPERTS = 8
+
 
 def _sync_every(n_chips: int) -> int:
     """In-flight collective-program cap (see utils.collective_sync_cadence
@@ -420,6 +435,133 @@ def lm_largevocab_phase() -> dict:
     return out
 
 
+def _ppep_model_ways(n_chips: int) -> int:
+    """Model-axis width for the PP/EP device phases: the largest of
+    {4, 2} that divides both the chip count and the block/expert
+    layout; 0 = no model axis on this machine (phase skipped)."""
+    for ways in (4, 2):
+        if n_chips >= ways and n_chips % ways == 0 \
+                and PP_EP_NUM_BLOCKS % ways == 0 \
+                and PP_EP_EXPERTS % ways == 0:
+            return ways
+    return 0
+
+
+def _time_resident_chunks(chunk_fn, state, data, chunk: int,
+                          timed_chunks: int, n_chips: int) -> float:
+    """Warm up (compile + hard readback), then time ``timed_chunks``
+    dispatches of a device-resident chunked step; returns seconds."""
+    state, m = chunk_fn(state, data)
+    float(m["loss"])  # hard readback so the clock starts clean
+    sync_every = _sync_every(n_chips)
+    t0 = time.perf_counter()
+    for c in range(1, timed_chunks + 1):
+        state, m = chunk_fn(state, data)
+        if sync_every and (c * chunk) % sync_every < chunk:
+            jax.block_until_ready(state.params)
+    jax.block_until_ready(state.params)
+    return time.perf_counter() - t0
+
+
+def pp_device_phase(n_chips) -> dict:
+    """Pipeline parallelism over a DEVICE-RESIDENT split: the GPipe
+    stage ring (blocks staged over the model axis, microbatch scan +
+    ppermute) fed by on-device batch sampling with lax.scan chunking —
+    zero host->device bytes per step, one dispatch per chunk
+    (training/device_step.make_pp_device_train_step). Reports
+    sequences/sec/chip as ``pp_images_per_sec_per_chip`` (the bench's
+    examples-rate convention); null fields on a 1-chip machine."""
+    ways = _ppep_model_ways(n_chips)
+    if not ways:
+        return {"pp_images_per_sec_per_chip": None,
+                "pp_device_skipped": f"no 2/4-way model axis over "
+                                     f"{n_chips} chip(s)"}
+    from distributed_tensorflow_tpu.data.device_data import put_device_data
+    from distributed_tensorflow_tpu.data.lm import LMDataSet
+    from distributed_tensorflow_tpu.models.transformer import TransformerLM
+    from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
+    from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS
+    from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+        shard_state_pp,
+    )
+    from distributed_tensorflow_tpu.training import adam, create_train_state
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_pp_device_train_step,
+    )
+
+    mesh = make_mesh(MeshSpec(data=-1, model=ways))
+    data_ways = mesh.shape[DATA_AXIS]
+    batch = PP_EP_BATCH_PER_DATA_WAY * data_ways
+    model = TransformerLM(
+        vocab_size=PP_EP_VOCAB, seq_len=PP_EP_SEQ_LEN,
+        d_model=PP_EP_D_MODEL, num_heads=4, num_blocks=PP_EP_NUM_BLOCKS,
+        compute_dtype=jnp.bfloat16)
+    opt = adam(1e-3)
+    ds = LMDataSet(PP_EP_SPLIT, seq_len=PP_EP_SEQ_LEN,
+                   vocab_size=PP_EP_VOCAB, seed=0)
+    data = put_device_data(ds, mesh, data_sharded=True)
+    state = shard_state_pp(create_train_state(model, opt, seed=0), mesh)
+    fn = make_pp_device_train_step(model, opt, mesh, batch, ways,
+                                   keep_prob=1.0, chunk=PP_EP_CHUNK)
+    dt = _time_resident_chunks(fn, state, data, PP_EP_CHUNK,
+                               PP_EP_TIMED_CHUNKS, n_chips)
+    rate = PP_EP_TIMED_CHUNKS * PP_EP_CHUNK * batch / dt / n_chips
+    return {"pp_images_per_sec_per_chip": round(rate, 1),
+            "pp_device_stages": ways, "pp_device_chunk": PP_EP_CHUNK,
+            "pp_device_global_batch": batch}
+
+
+def ep_device_phase(n_chips) -> dict:
+    """Switch-MoE expert parallelism over a DEVICE-RESIDENT split:
+    experts sharded over the model axis, on-device batch sampling,
+    lax.scan chunking (make_ep_device_train_step). Reports
+    ``ep_tokens_per_sec_per_chip``; null fields on a 1-chip machine."""
+    ways = _ppep_model_ways(n_chips)
+    if not ways:
+        return {"ep_tokens_per_sec_per_chip": None,
+                "ep_device_skipped": f"no 2/4-way model axis over "
+                                     f"{n_chips} chip(s)"}
+    from distributed_tensorflow_tpu.data.device_data import put_device_data
+    from distributed_tensorflow_tpu.data.lm import LMDataSet
+    from distributed_tensorflow_tpu.models.transformer import TransformerLM
+    from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
+    from distributed_tensorflow_tpu.parallel.expert_parallel import (
+        shard_state_ep,
+    )
+    from distributed_tensorflow_tpu.parallel.mesh import (
+        DATA_AXIS,
+        MODEL_AXIS,
+    )
+    from distributed_tensorflow_tpu.training import adam, create_train_state
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_ep_device_train_step,
+    )
+
+    mesh = make_mesh(MeshSpec(data=-1, model=ways))
+    data_ways = mesh.shape[DATA_AXIS]
+    batch = PP_EP_BATCH_PER_DATA_WAY * data_ways
+    kw = dict(vocab_size=PP_EP_VOCAB, seq_len=PP_EP_SEQ_LEN,
+              d_model=PP_EP_D_MODEL, num_heads=4, num_blocks=2,
+              moe_experts=PP_EP_EXPERTS, compute_dtype=jnp.bfloat16)
+    ep_model = TransformerLM(**kw, moe_axis=MODEL_AXIS)
+    opt = adam(1e-3)
+    ds = LMDataSet(PP_EP_SPLIT, seq_len=PP_EP_SEQ_LEN,
+                   vocab_size=PP_EP_VOCAB, seed=0)
+    data = put_device_data(ds, mesh, data_sharded=True)
+    state = shard_state_ep(
+        create_train_state(TransformerLM(**kw), opt, seed=0), mesh)
+    fn = make_ep_device_train_step(ep_model, opt, mesh, batch,
+                                   keep_prob=1.0, chunk=PP_EP_CHUNK)
+    dt = _time_resident_chunks(fn, state, data, PP_EP_CHUNK,
+                               PP_EP_TIMED_CHUNKS, n_chips)
+    rate = (PP_EP_TIMED_CHUNKS * PP_EP_CHUNK * batch * PP_EP_SEQ_LEN
+            / dt / n_chips)
+    return {"ep_tokens_per_sec_per_chip": round(rate, 1),
+            "ep_device_experts": PP_EP_EXPERTS,
+            "ep_device_chunk": PP_EP_CHUNK,
+            "ep_device_global_batch": batch}
+
+
 def feeddict_baseline_phase(ds, n_chips) -> float:
     """Measured same-machine baseline: the reference's per-step host feed
     (f32 pixels + one-hot f32 labels uploaded synchronously each step,
@@ -648,6 +790,8 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
         "value": None,
         "unit": "images/sec/chip",
         "vs_baseline": None,
+        "pp_images_per_sec_per_chip": None,
+        "ep_tokens_per_sec_per_chip": None,
         "tpu_unavailable": bool(tpu_unavailable),
         "phase_error": not tpu_unavailable,
         "error": str(error)[:300],
@@ -747,6 +891,10 @@ def _run_phases(out: dict):
             ps_emulation_phase(ds, wire="bf16"), 1)
     out.update(lm_longctx_phase())
     out.update(lm_largevocab_phase())
+    # r6: the parallelism matrix's last structural gap closed — PP/EP
+    # over the device-resident input path (skipped fields on 1 chip)
+    out.update(pp_device_phase(n_chips))
+    out.update(ep_device_phase(n_chips))
 
     print(json.dumps(out))
 

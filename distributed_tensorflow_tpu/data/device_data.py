@@ -82,7 +82,8 @@ def put_device_data_sp(split, mesh, per_token_targets: bool,
     return DeviceData(*out)
 
 
-def put_device_data(split, mesh=None) -> DeviceData:
+def put_device_data(split, mesh=None, *, data_sharded: bool = False
+                    ) -> DeviceData:
     """Stage a host ``DataSet`` split into HBM.
 
     With a mesh the arrays are replicated on every device (MNIST u8 is
@@ -97,13 +98,49 @@ def put_device_data(split, mesh=None) -> DeviceData:
     storage ((N, S) each — the x/y views of one (N, S+1) token table),
     and the sampled-gather step feeds them to the LM unchanged (ids are
     the thin-wire format; data/lm.py:121).
-    """
+
+    ``data_sharded=True`` (requires a mesh) splits the example axis over
+    the mesh's "data" axis instead, replicated over "model" — the layout
+    the PP/EP resident samplers want: each data row of devices holds its
+    1/D of the split and gathers minibatches from it with a
+    DATA-axis-folded key, so every stage/expert shard of a row draws the
+    SAME examples while rows sample disjoint pools (HBM cost per device
+    drops 1/D too). A remainder of fewer than D examples is trimmed
+    (sampling is with-replacement; the trim is below one batch of
+    noise). Single-process only in this version — PP/EP are."""
     toks = getattr(split, "_tokens", None)
     if toks is not None:
         x, y = toks[:, :-1], toks[:, 1:]
     else:
         x = split._raw_u8()
         y = split.labels_int.astype(np.int32)
+    if data_sharded:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS
+
+        if mesh is None:
+            raise ValueError("data_sharded staging needs a mesh")
+        if jax.process_count() > 1:
+            raise ValueError("data_sharded resident staging is "
+                             "single-process in this version (PP/EP are)")
+        n_data = mesh.shape[DATA_AXIS]
+        x, y = np.asarray(x), np.asarray(y)
+        n = len(y) - len(y) % n_data
+        if n == 0:
+            raise ValueError(
+                f"split of {len(y)} examples cannot shard over the "
+                f"{n_data}-way data axis (each row needs at least one "
+                f"example)")
+        x, y = x[:n], y[:n]
+        out = []
+        for arr in (x, y):
+            spec = P(DATA_AXIS, *([None] * (arr.ndim - 1)))
+            # numpy straight to the sharded layout: jnp.asarray first
+            # would materialize the FULL split on the default device —
+            # a transient HBM spike defeating the 1/D-per-device saving
+            out.append(jax.device_put(arr, NamedSharding(mesh, spec)))
+        return DeviceData(*out)
     if mesh is not None:
         from distributed_tensorflow_tpu.parallel.mesh import replicated_sharding
 

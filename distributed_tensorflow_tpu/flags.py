@@ -198,16 +198,25 @@ def define_reference_flags():
     DEFINE_boolean("device_data", False, "Stage the train split into HBM once "
                    "and sample batches ON DEVICE inside the compiled step "
                    "(zero host->device bytes per step; lax.scan runs "
-                   "--device_chunk steps per dispatch). Training batches are "
-                   "sampled with replacement rather than the reference's "
-                   "shuffled-epoch walk; display-step evals keep reference "
-                   "semantics (host-fed, dropout off)")
+                   "--device_chunk steps per dispatch). Composes with every "
+                   "parallel mode: plain DP/TP, --seq_parallel (token-"
+                   "sharded split), --pipeline and --expert_parallel (data-"
+                   "sharded split, per-shard salted PRNG streams). Training "
+                   "batches are sampled with replacement rather than the "
+                   "reference's shuffled-epoch walk; display-step evals keep "
+                   "reference semantics where a host batch exists (PP "
+                   "displays the step's own training metrics instead)")
     DEFINE_integer("device_chunk", 50, "Steps per compiled scan chunk in "
                    "--device_data mode (clamped to divide display_step)")
     DEFINE_float("clip_norm", 0.0, "If > 0, clip gradients to this global "
-                 "L2 norm before the optimizer update (local/sync/TP/"
-                 "device_data modes; ps mode keeps reference parity). "
-                 "Guards against early loss spikes at high learning rates")
+                 "L2 norm before the optimizer update (every mode except "
+                 "ps, which keeps reference parity). Under --pipeline / "
+                 "--expert_parallel the squared norm is psum'd over the "
+                 "model axis before scaling (stage/expert shards are exact "
+                 "partials), so the clipped trajectory exactly matches the "
+                 "single-device one and replicated leaves stay bit-"
+                 "identical. Guards against early loss spikes at high "
+                 "learning rates")
     DEFINE_integer("model_axis", 1, "Tensor-parallel ways on the mesh's "
                    "'model' axis (sync mode): the CNN's FC stack is "
                    "column/row-split and XLA inserts the collectives. "
@@ -277,7 +286,9 @@ def define_reference_flags():
                    "stage works a different microbatch "
                    "(parallel/pipeline_parallel.py). Mutually exclusive "
                    "with --seq_parallel; num_blocks must divide by "
-                   "--model_axis")
+                   "--model_axis. Composes with --device_data (the "
+                   "resident chunked sampler) and --clip_norm (axis-"
+                   "aware)")
     DEFINE_integer("pp_microbatches", 0, "Microbatches per step under "
                    "--pipeline (0 = the stage count, the GPipe "
                    "default); must divide the per-data-shard batch")
@@ -295,7 +306,9 @@ def define_reference_flags():
                    "(expert parallelism: every device routes "
                    "identically, computes its experts' tokens, one "
                    "psum combines — parallel/expert_parallel.py). "
-                   "Requires --moe_experts divisible by --model_axis")
+                   "Requires --moe_experts divisible by --model_axis. "
+                   "Composes with --device_data (the resident chunked "
+                   "sampler) and --clip_norm (axis-aware)")
     DEFINE_boolean("remat", False, "Rematerialize each transformer block "
                    "in the backward pass (jax.checkpoint): activation "
                    "memory drops to one block's worth at the cost of "

@@ -255,7 +255,10 @@ def _ring_forward(q, k, v, axis_name, causal):
     # arithmetic; f32 keeps the rescaling stable for bf16 inputs
     qf = q.astype(jnp.float32)
     perm = [(i, (i + 1) % p_size) for i in range(p_size)]
-    me = lax.axis_index(axis_name)
+    # axis_index only when the causal mask needs global positions: a
+    # non-causal ring never reads it, and a dead PartitionId in the
+    # lowered module breaks CPU SPMD partitioning on older jaxlibs
+    me = lax.axis_index(axis_name) if causal else jnp.int32(0)
     row_global = me * sq + jnp.arange(sq)  # my queries' global positions
 
     def attend(o, m, l, k_blk, v_blk, owner):
@@ -321,7 +324,8 @@ def _ring_bwd(axis_name, causal, res, g):
     qf = q.astype(jnp.float32)
     gf = jnp.einsum("bqhd->bhqd", g.astype(jnp.float32))
     perm = [(i, (i + 1) % p_size) for i in range(p_size)]
-    me = lax.axis_index(axis_name)
+    # same dead-PartitionId gate as the forward ring
+    me = lax.axis_index(axis_name) if causal else jnp.int32(0)
     row_global = me * sq + jnp.arange(sq)
     dD = jnp.sum(gf * o, axis=-1)  # (B, H, Sq)
 

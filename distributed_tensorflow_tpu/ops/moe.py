@@ -70,12 +70,16 @@ def switch_moe(h, params, *, capacity_factor: float = 1.25,
     probs = jax.nn.softmax(logits, axis=-1)          # (T, E)
     expert = jnp.argmax(probs, axis=-1)              # (T,)
     gate = jnp.max(probs, axis=-1)                   # (T,)
-    assign = jax.nn.one_hot(expert, e_total, dtype=jnp.float32)
-    # 1-based arrival position of each token in its expert's queue;
-    # tokens past the capacity are dropped (static shapes)
-    pos = jnp.cumsum(assign, axis=0) * assign        # (T, E)
+    # 1-based arrival position of each token in its expert's queue,
+    # computed with an INT32 cumsum — an f32 cumsum loses integer
+    # exactness past 2^24 tokens/shard and would silently corrupt
+    # dispatch slots; tokens past the capacity are dropped (static
+    # shapes). The f32 assignment matrix is a cast of the same one_hot.
+    assign_i = jax.nn.one_hot(expert, e_total, dtype=jnp.int32)
+    assign = assign_i.astype(jnp.float32)
+    pos = jnp.cumsum(assign_i, axis=0) * assign_i    # (T, E) int32
     keep = assign * (pos <= cap)
-    slot = jax.nn.one_hot((pos - 1.0).astype(jnp.int32), cap,
+    slot = jax.nn.one_hot(pos - 1, cap,
                           dtype=jnp.float32) * keep[..., None]  # (T,E,C)
 
     # load balance (Switch): E * sum_e f_e * p_e — from the FULL

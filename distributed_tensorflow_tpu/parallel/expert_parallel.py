@@ -46,6 +46,23 @@ def _is_expert_leaf(path) -> bool:
     return "moe" in keys and keys[-1] in _EXPERT_LEAVES
 
 
+def ep_clip_transform(max_norm: float):
+    """Axis-correct global-norm clip for INSIDE the EP ``shard_map``
+    step: expert-sharded leaves contribute their local squares as exact
+    partials (distinct experts per device), replicated leaves (router,
+    attention, embeddings, head) count once, the squared norm ``psum``s
+    over the expert axis, and every device applies the SAME scale — so
+    replicated leaves stay bit-identical across the axis (the
+    shard-local-norm divergence the plain ``clip_by_global_norm`` had
+    under --expert_parallel --clip_norm)."""
+    from distributed_tensorflow_tpu.training.train_state import (
+        clip_by_global_norm,
+    )
+
+    return clip_by_global_norm(max_norm, axis=MODEL_AXIS,
+                               sharded_leaf=_is_expert_leaf)
+
+
 def ep_state_specs(state: TrainState) -> TrainState:
     """PartitionSpec pytree: expert leaves split on their leading E axis
     over "model", everything else replicated; optimizer slots follow
@@ -80,12 +97,12 @@ def shard_state_ep(state: TrainState, mesh) -> TrainState:
     return jax.device_put(state, shardings)
 
 
-def make_ep_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
-                       donate: bool = True, grad_transform=None):
-    """Compiled expert-parallel train step: (EP-layout state, staged
-    batch) -> (state, metrics). ``model`` must carry
-    ``moe_axis=MODEL_AXIS`` (its switch_moe then slices local experts
-    and psums the combine) and ``moe_experts`` divisible by the axis."""
+def _ep_step_fn(model, optimizer, mesh, keep_prob: float, grad_transform):
+    """Validate the EP configuration and build the raw per-shard step
+    ``(state, (x, y)) -> (state, metrics)`` — the body both the host-fed
+    wrapper (``make_ep_train_step``) and the device-resident sampler
+    (``training/device_step.make_ep_device_train_step``) run inside
+    ``shard_map``."""
     if getattr(model, "moe_axis", None) != MODEL_AXIS:
         raise ValueError(
             f"model.moe_axis must be {MODEL_AXIS!r} for the EP step "
@@ -128,6 +145,17 @@ def make_ep_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
         return (TrainState(params, opt_state, state.step + 1, rng,
                            state.model_state), metrics)
 
+    return per_shard
+
+
+def make_ep_train_step(model, optimizer, mesh, keep_prob: float = 1.0,
+                       donate: bool = True, grad_transform=None):
+    """Compiled expert-parallel train step: (EP-layout state, staged
+    batch) -> (state, metrics). ``model`` must carry
+    ``moe_axis=MODEL_AXIS`` (its switch_moe then slices local experts
+    and psums the combine) and ``moe_experts`` divisible by the axis."""
+    per_shard = _ep_step_fn(model, optimizer, mesh, keep_prob,
+                            grad_transform)
     data_spec = (P(DATA_AXIS, None), P(DATA_AXIS, None))
     cache: dict = {}
 

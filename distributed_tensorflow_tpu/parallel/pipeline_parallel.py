@@ -114,14 +114,38 @@ def pp_state_sharding(state: TrainState, mesh):
                         is_leaf=lambda v: isinstance(v, P))
 
 
+def is_stage_leaf(path) -> bool:
+    """True for param-tree paths under ``blocks`` — the leaves whose
+    per-device values are DISTINCT stage shards (the stacked leading
+    axis splits over "model"); everything else replicates. The ONE
+    statement of the rule, shared by the spec derivation, the gradient
+    reduction, and the axis-aware clip."""
+    keys = tuple(getattr(p, "key", getattr(p, "idx", None)) for p in path)
+    return keys[:1] == ("blocks",)
+
+
+def pp_clip_transform(max_norm: float):
+    """Axis-correct global-norm clip for INSIDE the PP ``shard_map``
+    step: stage-sharded block leaves contribute their local squares as
+    exact partials, replicated leaves count once, the squared norm
+    ``psum``s over the stage axis, and every device applies the SAME
+    scale — so replicated leaves (tok/pos/ln_f/head) stay bit-identical
+    across stages (the stage-local-norm divergence the plain
+    ``clip_by_global_norm`` had here)."""
+    from distributed_tensorflow_tpu.training.train_state import (
+        clip_by_global_norm,
+    )
+
+    return clip_by_global_norm(max_norm, axis=MODEL_AXIS,
+                               sharded_leaf=is_stage_leaf)
+
+
 def pp_state_specs(state: TrainState) -> TrainState:
     """PartitionSpec pytree for a STACKED-params TrainState — the one
     place the blocks-split-over-model rule is written (shard_map specs
     and device shardings both derive from it)."""
     def block_or_rep(path, _leaf):
-        keys = tuple(getattr(p, "key", getattr(p, "idx", None))
-                     for p in path)
-        return P(MODEL_AXIS) if keys[:1] == ("blocks",) else P()
+        return P(MODEL_AXIS) if is_stage_leaf(path) else P()
 
     pspecs = jax.tree_util.tree_map_with_path(block_or_rep, state.params)
     pstruct = jax.tree.structure(state.params)
@@ -172,18 +196,13 @@ def _attn_for(model):
     return lambda q, k, v: multi_head_attention(q, k, v, causal=True)
 
 
-def make_pp_train_step(model, optimizer, mesh, microbatches: int,
-                       keep_prob: float = 1.0, donate: bool = True,
-                       grad_transform=None):
-    """Compiled pipeline-parallel train step for ``TransformerLM``:
-    (PP-layout state, staged batch) -> (state, metrics).
-
-    The mesh's "model" axis size is the stage count K; ``microbatches``
-    (M) must divide the per-data-shard batch. The model must be a plain
-    (seq_axis=None) LM — attention flavors (dense or ``attn_block``)
-    and the streamed CE head (``ce_block``) all work; blocks split K
-    ways. Matches ``compute_grads(accum_steps=M)`` trajectories (the
-    per-microbatch rng fold is the same)."""
+def _pp_step_fn(model, optimizer, mesh, microbatches: int,
+                keep_prob: float, grad_transform):
+    """Validate the PP configuration and build the raw per-shard step
+    ``(state, (x, y)) -> (state, metrics)`` — the body both the host-fed
+    wrapper (``make_pp_train_step``) and the device-resident sampler
+    (``training/device_step.make_pp_device_train_step``) run inside
+    ``shard_map``."""
     if getattr(model, "seq_axis", None) is not None:
         raise ValueError("pipeline parallelism stages BLOCKS; it does "
                          "not compose with seq_axis (ring attention) — "
@@ -224,9 +243,7 @@ def make_pp_train_step(model, optimizer, mesh, microbatches: int,
         acc = lax.psum(acc, MODEL_AXIS)
 
         def reduce_g(path, g):
-            keys = tuple(getattr(p, "key", getattr(p, "idx", None))
-                         for p in path)
-            if keys and keys[0] == "blocks":
+            if is_stage_leaf(path):
                 return g
             return lax.psum(g, MODEL_AXIS)
 
@@ -242,6 +259,23 @@ def make_pp_train_step(model, optimizer, mesh, microbatches: int,
         return (TrainState(params, opt_state, state.step + 1, rng,
                            state.model_state), metrics)
 
+    return step
+
+
+def make_pp_train_step(model, optimizer, mesh, microbatches: int,
+                       keep_prob: float = 1.0, donate: bool = True,
+                       grad_transform=None):
+    """Compiled pipeline-parallel train step for ``TransformerLM``:
+    (PP-layout state, staged batch) -> (state, metrics).
+
+    The mesh's "model" axis size is the stage count K; ``microbatches``
+    (M) must divide the per-data-shard batch. The model must be a plain
+    (seq_axis=None) LM — attention flavors (dense or ``attn_block``)
+    and the streamed CE head (``ce_block``) all work; blocks split K
+    ways. Matches ``compute_grads(accum_steps=M)`` trajectories (the
+    per-microbatch rng fold is the same)."""
+    step = _pp_step_fn(model, optimizer, mesh, microbatches, keep_prob,
+                       grad_transform)
     data_spec = (P(DATA_AXIS, None), P(DATA_AXIS, None))
     cache: dict = {}
 

@@ -212,6 +212,117 @@ def make_device_sp_train_step(sp_model, optimizer, mesh, batch_size: int, *,
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
+def _make_resident_sharded_step(per_shard_step, state_specs_fn, mesh,
+                                local_batch: int, chunk: int,
+                                donate: bool):
+    """Shared PP/EP resident-sampler wrapper: the DATA-axis-folded
+    sample body lives HERE, once — every model-axis shard (stage or
+    expert) of a data row folds the SAME (salt, data-index) key and so
+    draws the SAME rows from its local 1/D of the split (the
+    replicated-batch invariant both modes rest on); ``lax.scan`` runs
+    ``chunk`` steps per dispatch, and the shard_map/jit pair is cached
+    on first call (state specs need a concrete state)."""
+    from distributed_tensorflow_tpu.data.device_data import DeviceData
+
+    def body(state: TrainState, data):
+        samp = jax.random.fold_in(state.rng, _SAMPLE_SALT)
+        # DATA-axis fold only — the staged batch is replicated over the
+        # model axis. The dropout stream is the wrapped step's own (it
+        # folds DATA itself).
+        samp = jax.random.fold_in(samp, lax.axis_index(DATA_AXIS))
+        idx = jax.random.randint(samp, (local_batch,), 0,
+                                 data.num_examples)
+        batch = (data.images[idx].astype(jnp.int32),
+                 data.labels[idx].astype(jnp.int32))
+        return per_shard_step(state, batch)
+
+    data_spec = DeviceData(P(DATA_AXIS, None), P(DATA_AXIS, None))
+    cache: dict = {}
+
+    def call(state, data):
+        fn = cache.get("fn")
+        if fn is None:
+            specs = state_specs_fn(state)
+            sharded = jax.shard_map(
+                _scan_chunk(body, chunk), mesh=mesh,
+                in_specs=(specs, data_spec),
+                out_specs=(specs, P()),
+                check_vma=False)
+            fn = cache["fn"] = jax.jit(
+                sharded, donate_argnums=(0,) if donate else ())
+        return fn(state, data)
+
+    return call
+
+
+def make_pp_device_train_step(model, optimizer, mesh, batch_size: int,
+                              microbatches: int, *, keep_prob: float = 1.0,
+                              chunk: int = 1, donate: bool = True,
+                              grad_transform=None):
+    """Pipeline-parallel chunked step over device-resident data — the
+    GPipe schedule composed with the zero-host-bytes input path. The
+    split lives DATA-SHARDED in HBM (``put_device_data(...,
+    data_sharded=True)``: each data row of devices holds its 1/D of the
+    examples, replicated over the stage axis); inside ``shard_map`` each
+    device samples its local minibatch with a key folded on the DATA
+    axis index ONLY — every stage of a data row draws the SAME rows, so
+    its gather yields exactly its per-shard batch with no collective on
+    the input side. The rest is the PP train step verbatim
+    (parallel/pipeline_parallel._pp_step_fn: microbatch scan + ppermute
+    ring, psum'd replicated-leaf grads), and ``lax.scan`` runs ``chunk``
+    steps per dispatch. ``grad_transform`` composes inside the step —
+    pass ``pp_clip_transform`` for an axis-correct --clip_norm."""
+    from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+        _pp_step_fn,
+        pp_state_specs,
+    )
+
+    n_data = mesh.shape[DATA_AXIS]
+    if batch_size % n_data:
+        raise ValueError(
+            f"batch_size={batch_size} not divisible by the {n_data}-way "
+            f"data axis")
+    local_batch = batch_size // n_data
+    if local_batch % int(microbatches):
+        raise ValueError(
+            f"per-shard batch {local_batch} must split into "
+            f"{microbatches} microbatches")
+    pp_step = _pp_step_fn(model, optimizer, mesh, microbatches, keep_prob,
+                          grad_transform)
+    return _make_resident_sharded_step(pp_step, pp_state_specs, mesh,
+                                       local_batch, chunk, donate)
+
+
+def make_ep_device_train_step(model, optimizer, mesh, batch_size: int, *,
+                              keep_prob: float = 1.0, chunk: int = 1,
+                              donate: bool = True, grad_transform=None):
+    """Expert-parallel chunked step over device-resident data — Switch
+    MoE expert sharding composed with the zero-host-bytes input path.
+    Same layout/sampling contract as the PP variant (data-sharded split,
+    DATA-axis-folded sample key so every expert shard of a data row
+    draws the SAME rows — the replicated-activation invariant the
+    psum-combine rests on), with the EP gradient accounting verbatim
+    (parallel/expert_parallel._ep_step_fn: 1/P loss seed, expert-shard
+    grads as exact partials, psum'd replicated leaves). ``model`` must
+    carry ``moe_axis=MODEL_AXIS``; pass ``ep_clip_transform`` as
+    ``grad_transform`` for an axis-correct --clip_norm."""
+    from distributed_tensorflow_tpu.parallel.expert_parallel import (
+        _ep_step_fn,
+        ep_state_specs,
+    )
+
+    n_data = mesh.shape[DATA_AXIS]
+    if batch_size % n_data:
+        raise ValueError(
+            f"batch_size={batch_size} not divisible by the {n_data}-way "
+            f"data axis")
+    local_batch = batch_size // n_data
+    ep_step = _ep_step_fn(model, optimizer, mesh, keep_prob,
+                          grad_transform)
+    return _make_resident_sharded_step(ep_step, ep_state_specs, mesh,
+                                       local_batch, chunk, donate)
+
+
 def make_device_tp_train_step(model, optimizer, mesh, batch_size: int, *,
                               keep_prob: float = 1.0, chunk: int = 1,
                               donate: bool = True, grad_transform=None,

@@ -202,8 +202,9 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                              "--expert_parallel are mutually exclusive "
                              "model-axis strategies — pick one")
         return _train_pipeline(FLAGS, ds, model, opt, state, mode,
-                               model_axis, clip)
+                               model_axis)
     sp_device_model = None  # set by the SP branch for --device_data
+    ep_device_model = None  # set by the EP branch for --device_data
     if getattr(FLAGS, "expert_parallel", False):
         # expert parallelism: MoE experts sharded --model_axis ways
         # (parallel/expert_parallel.py); the EP twin carries moe_axis
@@ -214,6 +215,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         )
         from distributed_tensorflow_tpu.parallel import MeshSpec
         from distributed_tensorflow_tpu.parallel.expert_parallel import (
+            ep_clip_transform,
             make_ep_eval_step,
             make_ep_train_step,
             shard_state_ep,
@@ -242,13 +244,16 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             raise ValueError("--expert_parallel, --seq_parallel and "
                              "--pipeline each claim the model axis — "
                              "pick one")
-        if getattr(FLAGS, "device_data", False):
-            raise ValueError("--device_data is not wired for "
-                             "--expert_parallel yet")
         if accum > 1:
             raise ValueError("--accum_steps is not wired for "
                              "--expert_parallel yet; raise --batch_size "
                              "instead")
+        if clip is not None:
+            # the plain clip inside shard_map would scale by a
+            # shard-LOCAL norm and diverge the replicated leaves — use
+            # the axis-aware transform (psum'd squared-norm partials
+            # over the expert axis, one scale everywhere)
+            clip = ep_clip_transform(FLAGS.clip_norm)
         ep_model = TransformerLM(
             vocab_size=model.vocab_size, seq_len=model.seq_len,
             d_model=model.d_model, num_heads=model.num_heads,
@@ -275,6 +280,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                      NamedSharding(mesh, P(DATA_AXIS, None)))
         stage = lambda b: put_global(_ep_specs, b)
         restage = lambda s: shard_state_ep(s, mesh)
+        ep_device_model = ep_model  # --device_data: the chunked EP step
     elif getattr(FLAGS, "seq_parallel", False):
         # sequence/context parallelism: tokens sharded --model_axis ways,
         # ring attention over the mesh's "model" axis
@@ -537,9 +543,11 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
             )
         return _train_device_resident(
             FLAGS, ds, model, opt, state, mesh, n_chips, eval_fn, stage, clip,
-            tp=(mode == "sync" and model_axis > 1 and sp_device_model is None),
+            tp=(mode == "sync" and model_axis > 1 and sp_device_model is None
+                and ep_device_model is None),
             restage=restage, augment_fn=augment,
-            sp_model=sp_device_model, per_token_targets=is_lm)
+            sp_model=sp_device_model, per_token_targets=is_lm,
+            ep_model=ep_device_model)
 
     sv = Supervisor(
         is_chief=(FLAGS.task_index == 0),
@@ -955,8 +963,8 @@ class _HostCoordinator:
         self._stop = bool(votes[:, 0].max())
 
 
-def _train_pipeline(FLAGS, ds, model, opt, state, mode, model_axis,
-                    clip) -> TrainResult:
+def _train_pipeline(FLAGS, ds, model, opt, state, mode,
+                    model_axis) -> TrainResult:
     """--pipeline training: GPipe-style staged transformer blocks over
     the mesh's "model" axis (parallel/pipeline_parallel.py).
 
@@ -967,12 +975,18 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode, model_axis,
     hard kill can lose at most the steps since the last boundary).
     Display prints the step's own training metrics (the device-resident
     mode's documented trade — the per-step host batch the reference's
-    pre-update eval wants would stall the pipeline)."""
+    pre-update eval wants would stall the pipeline). --clip_norm runs
+    the AXIS-AWARE transform (pp_clip_transform): the squared norm
+    psums over the stage axis before scaling, so replicated leaves
+    stay bit-identical across stages. With --device_data the split
+    stages data-sharded into HBM and the chunked sampler
+    (_train_pipeline_device) replaces the host-fed loop."""
     from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
     from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS
     from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
         fetch_state_pp,
         make_pp_train_step,
+        pp_clip_transform,
         shard_state_pp,
         stage_batch_pp,
     )
@@ -990,14 +1004,15 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode, model_axis,
                          "(the stage ring would need the multi-host "
                          "coordinator); use --seq_parallel "
                          "--sp_span_hosts for cross-host model axes")
-    for flag in ("device_data", "augment"):
-        if getattr(FLAGS, flag, False):
-            raise ValueError(f"--{flag} is not supported with --pipeline")
+    if getattr(FLAGS, "augment", False):
+        raise ValueError("--augment is not supported with --pipeline")
     if max(1, getattr(FLAGS, "accum_steps", 1)) > 1:
         raise ValueError("--accum_steps is redundant with --pipeline: "
                          "microbatching IS the pipeline schedule — set "
                          "--pp_microbatches instead")
 
+    clip = (pp_clip_transform(FLAGS.clip_norm)
+            if getattr(FLAGS, "clip_norm", 0.0) > 0 else None)
     mesh = make_mesh(MeshSpec(data=-1, model=model_axis))
     n_chips = mesh.devices.size
     data_ways = mesh.shape[DATA_AXIS]
@@ -1009,6 +1024,10 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode, model_axis,
         raise ValueError(
             f"each data shard's slice ({FLAGS.batch_size // data_ways}) "
             f"must split into {micro} microbatches (--pp_microbatches)")
+
+    if getattr(FLAGS, "device_data", False):
+        return _train_pipeline_device(FLAGS, ds, model, opt, state, mesh,
+                                      n_chips, micro, clip)
 
     step_fn = make_pp_train_step(model, opt, mesh, micro,
                                  keep_prob=FLAGS.keep_prob,
@@ -1076,11 +1095,135 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode, model_axis,
     )
 
 
+def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
+                           micro, clip) -> TrainResult:
+    """--pipeline --device_data: the GPipe stage ring over a DEVICE-
+    RESIDENT split. The split stages data-sharded into HBM once
+    (``put_device_data(..., data_sharded=True)``); every step samples
+    its per-shard batch inside ``shard_map`` from the step PRNG and
+    ``lax.scan`` runs ``--device_chunk`` steps per dispatch
+    (device_step.make_pp_device_train_step) — zero host->device bytes
+    per step, one compiled call per chunk. The live state keeps the
+    STACKED stage-sharded layout between dispatches; the standard-
+    layout host state (checkpoint format) is fetched only at display /
+    eval / cadence boundaries, exactly the host-fed PP loop's contract
+    (a hard kill can lose at most the steps since the last boundary).
+    Display shows the chunk's last training metrics (the documented
+    device-resident trade: no host batch exists to pre-eval)."""
+    import math
+
+    from distributed_tensorflow_tpu.data.device_data import put_device_data
+    from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+        fetch_state_pp,
+        shard_state_pp,
+    )
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_pp_device_train_step,
+    )
+
+    data = put_device_data(ds.train, mesh, data_sharded=True)
+    chunk = max(1, math.gcd(FLAGS.display_step, max(1, FLAGS.device_chunk)))
+    if chunk != FLAGS.device_chunk:
+        print(f"--device_chunk={FLAGS.device_chunk} clamped to {chunk} so "
+              f"chunks land on --display_step={FLAGS.display_step} "
+              f"boundaries (dispatch amortization shrinks accordingly)")
+
+    chunk_fns: dict[int, Any] = {}
+
+    def run_chunk(pp_state, length: int):
+        fn = chunk_fns.get(length)
+        if fn is None:
+            fn = chunk_fns[length] = make_pp_device_train_step(
+                model, opt, mesh, FLAGS.batch_size, micro,
+                keep_prob=FLAGS.keep_prob, chunk=length,
+                grad_transform=clip)
+        return fn(pp_state, data)
+
+    sv = Supervisor(
+        is_chief=(FLAGS.task_index == 0),
+        logdir=FLAGS.logdir,
+        save_model_secs=FLAGS.save_model_secs,
+        max_to_keep=max_to_keep_from_flags(FLAGS),
+        background_save=background_save_from_flags(FLAGS),
+        sharded_spanning=bool(getattr(FLAGS, "sharded_checkpoint", True)),
+    )
+    logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
+                           job_name=FLAGS.job_name or "worker",
+                           task_index=FLAGS.task_index)
+    meter = Throughput(FLAGS.batch_size, n_chips)
+    last_display = {}
+    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
+    eval_every = max(0, getattr(FLAGS, "eval_step", 0))
+    sync_every = collective_sync_cadence(True)
+    chunks_done = 0
+
+    with sv.managed(state) as box:
+        step = box.step
+        periodic_eval.prime(step)
+        pp_state = shard_state_pp(box.state, mesh)
+        host = box.state
+        compile_done = False
+        meter.reset()
+        while not sv.should_stop() and step < FLAGS.training_iter:
+            # realign to display boundaries after a resume from an
+            # arbitrary checkpointed step, then cap at the budget
+            to_boundary = -step % FLAGS.display_step or chunk
+            length = min(chunk, to_boundary, FLAGS.training_iter - step)
+            pp_state, m = run_chunk(pp_state, length)
+            step += length
+            meter.step(length * FLAGS.batch_size)
+            chunks_done += 1
+            if sync_every and chunks_done % max(1, sync_every // chunk) == 0:
+                jax.block_until_ready(pp_state.params)
+            if not compile_done:
+                jax.block_until_ready(pp_state.params)
+                meter.reset()
+                compile_done = True
+            # eval boundaries use CROSSING semantics — a chunk can jump
+            # clean over `step % eval_every == 0` (chunks align to
+            # display_step, not eval_step), so fire on the chunk that
+            # crossed; periodic_eval's own crossing logic evaluates once
+            boundary = (step % FLAGS.display_step == 0
+                        or (eval_every and
+                            (step - length) // eval_every
+                            != step // eval_every)
+                        or sv.checkpointer.cadence_due()
+                        or step >= FLAGS.training_iter)
+            if boundary:
+                host = fetch_state_pp(pp_state, model)
+                box.update(host, step)
+                if step % FLAGS.display_step == 0:
+                    last_display = {k: float(v) for k, v in m.items()}
+                    logger.log_display(step, last_display["loss"],
+                                       last_display["accuracy"])
+                    logger.scalars(
+                        step, {"images_per_sec": meter.images_per_sec})
+                periodic_eval(host, step)
+                sv.maybe_checkpoint(host, step)
+        jax.block_until_ready(pp_state.params)
+        host = fetch_state_pp(pp_state, model)
+        box.update(host, step)
+
+    test_metrics = _final_test_eval(FLAGS, sv, periodic_eval, model, host,
+                                    ds, logger, step)
+    print("Optimization Finished!")
+    logger.close()
+    return TrainResult(
+        final_step=step,
+        train_metrics=last_display,
+        test_metrics=test_metrics,
+        images_per_sec=meter.images_per_sec,
+        images_per_sec_per_chip=meter.images_per_sec_per_chip,
+        n_chips=n_chips,
+    )
+
+
 def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                            eval_fn, stage, grad_transform=None,
                            tp: bool = False, restage=None,
                            augment_fn=None, sp_model=None,
-                           per_token_targets: bool = False) -> TrainResult:
+                           per_token_targets: bool = False,
+                           ep_model=None) -> TrainResult:
     """--device_data training: the split resident in HBM, batches sampled on
     device, ``lax.scan`` chunks amortizing dispatch (training/device_step).
     Per training step NOTHING crosses the host boundary; per display step
@@ -1088,7 +1231,11 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
     (dropout-off, before-the-update — ``MNISTDist.py:179-182``).
     ``sp_model`` (seq_axis twin) routes the sequence-parallel composition:
     the split stages token-axis-sharded and the chunked step samples
-    inside shard_map (device_step.make_device_sp_train_step)."""
+    inside shard_map (device_step.make_device_sp_train_step).
+    ``ep_model`` (moe_axis twin) routes the expert-parallel composition:
+    the split stages data-axis-sharded and the chunked step samples
+    inside shard_map (device_step.make_ep_device_train_step);
+    ``grad_transform`` arrives already axis-aware (ep_clip_transform)."""
     import math
 
     from distributed_tensorflow_tpu.data.device_data import (
@@ -1100,6 +1247,7 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
         make_device_sp_train_step,
         make_device_tp_train_step,
         make_device_train_step,
+        make_ep_device_train_step,
     )
 
     if sp_model is not None:
@@ -1107,6 +1255,8 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                        else (sp_model.seq_len, sp_model.token_dim))
         data = put_device_data_sp(ds.train, mesh, per_token_targets,
                                   token_shape=token_shape)
+    elif ep_model is not None:
+        data = put_device_data(ds.train, mesh, data_sharded=True)
     else:
         data = put_device_data(ds.train, mesh)
     chunk = max(1, math.gcd(FLAGS.display_step, max(1, FLAGS.device_chunk)))
@@ -1122,6 +1272,11 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                 keep_prob=FLAGS.keep_prob, chunk=length,
                 grad_transform=grad_transform,
                 per_token_targets=per_token_targets)
+        if ep_model is not None:
+            return make_ep_device_train_step(
+                ep_model, opt, mesh, FLAGS.batch_size,
+                keep_prob=FLAGS.keep_prob, chunk=length,
+                grad_transform=grad_transform)
         if tp:
             # GSPMD: the state's TP layout + the data-axis batch constraint
             # drive the partitioner

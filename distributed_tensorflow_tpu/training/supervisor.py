@@ -99,7 +99,28 @@ class Supervisor:
         rng. Restoring one into a full-TrainState run adopts its params
         and step and keeps the fresh optimizer state. (The reverse needs
         nothing: full-state checkpoints are a superset of the ps layout,
-        and restore ignores extra keys.)"""
+        and restore ignores extra keys.)
+
+        A ``FileNotFoundError`` mid-restore means a sharded set that was
+        complete at selection time vanished under us (a racing peer's
+        GC deleted it between ``latest_checkpoint`` and the read —
+        ``checkpoint_keys``/``load_flat_sharded`` both raise it). That
+        is a transient of healthy concurrent operation, not a broken
+        run: re-scan — the next ``latest_checkpoint`` pass no longer
+        sees the vanished set and picks the newest OLDER complete
+        checkpoint. Bounded so a genuinely sick directory still fails
+        loudly."""
+        for attempt in range(2):
+            try:
+                return self._init_or_restore_once(init_state)
+            except FileNotFoundError as e:
+                print(f"checkpoint vanished mid-restore (racing peer "
+                      f"GC?): {e} — re-scanning for an older complete "
+                      f"checkpoint (attempt {attempt + 1}/3)")
+        # third and final attempt: an error here is the loud exit
+        return self._init_or_restore_once(init_state)
+
+    def _init_or_restore_once(self, init_state):
         try:
             restored = self.checkpointer.restore(init_state)
         except KeyError as e:

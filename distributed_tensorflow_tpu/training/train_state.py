@@ -172,7 +172,8 @@ def apply_updates(params, updates):
     return jax.tree.map(lambda p, u: p + u.astype(p.dtype), params, updates)
 
 
-def clip_by_global_norm(max_norm: float):
+def clip_by_global_norm(max_norm: float, *, axis: str | None = None,
+                        sharded_leaf=None):
     """Gradient transform: scale the whole grad pytree so its global L2
     norm is at most ``max_norm`` (the classic tf.clip_by_global_norm).
 
@@ -181,12 +182,36 @@ def clip_by_global_norm(max_norm: float):
     lr 1e-2, frying the ReLUs into a dead plateau); one clip makes every
     optimizer robust to that. Composes with DP/TP: it runs on the
     already-aggregated grads, and under GSPMD the norm reduction is
-    partitioned by XLA like any other reduction."""
+    partitioned by XLA like any other reduction.
+
+    ``axis`` makes the clip AXIS-AWARE for ``shard_map`` steps whose grad
+    pytree is SPLIT over a mesh axis (pipeline stages, expert shards): the
+    transform computes a per-device squared-norm PARTIAL — sharded leaves
+    (``sharded_leaf(path)`` True) contribute their full square (each
+    device holds a distinct shard, so local squares are exact partials of
+    the global sum), replicated leaves contribute ``1/axis_size`` of
+    theirs (every device holds the full copy; the psum must count it
+    once) — ``psum``s the partials over ``axis``, and only then scales.
+    The resulting norm (and therefore the scale) is IDENTICAL on every
+    device of the axis, so replicated leaves stay bit-identical — the
+    stage-local-norm divergence the plain form had under PP/EP."""
     max_norm = float(max_norm)
 
     def transform(grads):
-        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                 for g in jax.tree.leaves(grads))
+        if axis is None:
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for g in jax.tree.leaves(grads))
+        else:
+            inv = 1.0 / lax.axis_size(axis)
+
+            def partial_sq(path, g):
+                s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+                if sharded_leaf is not None and sharded_leaf(path):
+                    return s  # distinct shard: exact partial
+                return s * inv  # replicated: count once across the axis
+
+            parts = jax.tree_util.tree_map_with_path(partial_sq, grads)
+            sq = lax.psum(sum(jax.tree.leaves(parts)), axis)
         norm = jnp.sqrt(sq)
         scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
         return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)

@@ -244,6 +244,50 @@ def test_main_phase_software_error_exits_nonzero(monkeypatch, capsys):
     assert rec["n_chips"] == 1
 
 
+def _shrink_ppep(monkeypatch):
+    monkeypatch.setattr(bench, "PP_EP_SEQ_LEN", 32)
+    monkeypatch.setattr(bench, "PP_EP_VOCAB", 16)
+    monkeypatch.setattr(bench, "PP_EP_D_MODEL", 32)
+    monkeypatch.setattr(bench, "PP_EP_SPLIT", 64)
+    monkeypatch.setattr(bench, "PP_EP_BATCH_PER_DATA_WAY", 4)
+    monkeypatch.setattr(bench, "PP_EP_CHUNK", 2)
+    monkeypatch.setattr(bench, "PP_EP_TIMED_CHUNKS", 1)
+
+
+@pytest.mark.slow  # the compile-heavy phase bodies; the mesh paths they
+                   # drive are tier-1-covered by tests/test_device_pp_ep.py
+def test_pp_device_phase_runs(monkeypatch):
+    _shrink_ppep(monkeypatch)
+    out = bench.pp_device_phase(8)
+    assert out["pp_images_per_sec_per_chip"] > 0
+    assert out["pp_device_stages"] == 4
+
+
+@pytest.mark.slow
+def test_ep_device_phase_runs(monkeypatch):
+    _shrink_ppep(monkeypatch)
+    out = bench.ep_device_phase(8)
+    assert out["ep_tokens_per_sec_per_chip"] > 0
+    assert out["ep_device_experts"] == bench.PP_EP_EXPERTS
+
+
+def test_ppep_phases_skip_on_one_chip():
+    """1 chip has no model axis: the phases must report null metrics
+    with a reason, not crash (the r5 hardened-artifact pattern)."""
+    pp, ep = bench.pp_device_phase(1), bench.ep_device_phase(1)
+    assert pp["pp_images_per_sec_per_chip"] is None
+    assert ep["ep_tokens_per_sec_per_chip"] is None
+    assert "pp_device_skipped" in pp and "ep_device_skipped" in ep
+
+
+def test_degraded_record_nulls_ppep_keys():
+    """Outage artifacts carry the PP/EP headline keys as nulls so the
+    driver's schema stays stable across outages."""
+    rec = bench.degraded_record("UNAVAILABLE", {}, cpu_smoke=False)
+    assert rec["pp_images_per_sec_per_chip"] is None
+    assert rec["ep_tokens_per_sec_per_chip"] is None
+
+
 def test_lm_largevocab_phase_runs(monkeypatch):
     monkeypatch.setattr(bench, "LM_BIGV_VOCAB", 512)
     monkeypatch.setattr(bench, "LM_BIGV_SEQ_LEN", 64)

@@ -477,3 +477,41 @@ def test_exit_agreement_carries_attempt_token():
     assert verdict is False and token is None
     # the 1-arg form is unchanged for existing callers
     assert agree_clean_exit(True, timeout_s=30.0) is True
+
+
+def test_restore_rescans_when_sharded_set_vanishes_midway(tmp_path,
+                                                          monkeypatch):
+    """A sharded set that was complete at selection time can vanish
+    between latest_checkpoint and the read (racing peer GC) —
+    checkpoint_keys/load_flat_sharded raise FileNotFoundError. The
+    Supervisor must degrade to a RE-SCAN (picking the newest older
+    complete checkpoint), not crash the restore (advisor-low
+    supervisor.py)."""
+    import distributed_tensorflow_tpu.checkpoint.checkpoint as ckpt_mod
+
+    state = _state()
+    save_checkpoint(str(tmp_path), state, step=3)
+    save_checkpoint(str(tmp_path), state, step=7)
+
+    real_load = ckpt_mod.load_flat
+    raced = {"n": 0}
+
+    def racing_load(path):
+        if path.endswith("ckpt-7.npz") and raced["n"] == 0:
+            # the set vanishes under the reader exactly once
+            raced["n"] += 1
+            os.unlink(path)
+            raise FileNotFoundError(
+                f"sharded checkpoint set for {path!r} is no longer "
+                f"complete")
+        return real_load(path)
+
+    monkeypatch.setattr(ckpt_mod, "load_flat", racing_load)
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path),
+                    save_model_secs=10_000)
+    restored, step = sv.init_or_restore(state)
+    assert raced["n"] == 1
+    assert step == 3  # fell back to the older complete checkpoint
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

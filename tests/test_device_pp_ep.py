@@ -1,0 +1,386 @@
+"""Device-resident stepping for pipeline- and expert-parallel training
+(training/device_step.make_pp_device_train_step / make_ep_device_train_step)
+plus the axis-correct global-norm clip (the advisor's two high-severity
+divergence bugs): trajectory equivalence against the host-fed steps given
+the same sampled batches, exact-clip trajectories against the single-device
+clipped step (replicated leaves bit-identical across the model axis), the
+zero-transfer/one-dispatch-per-chunk contract, and the --device_data
+--pipeline / --expert_parallel CLI paths the guards used to reject."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.device_data import put_device_data
+from distributed_tensorflow_tpu.data.lm import LMDataSet
+from distributed_tensorflow_tpu.models.transformer import TransformerLM
+from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
+from distributed_tensorflow_tpu.parallel.expert_parallel import (
+    ep_clip_transform,
+    make_ep_train_step,
+    shard_state_ep,
+)
+from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
+from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+    fetch_state_pp,
+    make_pp_train_step,
+    pp_clip_transform,
+    shard_state_pp,
+    stage_batch_pp,
+)
+from distributed_tensorflow_tpu.training import (
+    create_train_state,
+    get_optimizer,
+    make_train_step,
+)
+from distributed_tensorflow_tpu.training.device_step import (
+    _SAMPLE_SALT,
+    make_ep_device_train_step,
+    make_pp_device_train_step,
+)
+from distributed_tensorflow_tpu.training.train_state import (
+    clip_by_global_norm,
+)
+
+KW = dict(vocab_size=16, seq_len=32, d_model=32, num_heads=2, num_blocks=4)
+MOE_KW = dict(vocab_size=16, seq_len=32, d_model=32, num_heads=2,
+              num_blocks=2, moe_experts=4, moe_capacity=8.0)
+
+
+def _sampled_global_batch(rng, split, data_ways: int, local_batch: int):
+    """Replicate the resident samplers' PRNG math on the host: the split
+    is DATA-SHARDED (row-major: shard a holds rows [a*N/D, (a+1)*N/D)),
+    each data shard folds (salt, its axis index) on the step rng and
+    gathers local rows — the global batch is the shards' rows
+    concatenated (stage order of P(DATA_AXIS, None))."""
+    x_all = np.asarray(split.images)
+    y_all = np.asarray(split.labels)
+    local_n = len(x_all) // data_ways
+    xs, ys = [], []
+    for a in range(data_ways):
+        samp = jax.random.fold_in(rng, _SAMPLE_SALT)
+        samp = jax.random.fold_in(samp, a)
+        idx = np.asarray(jax.random.randint(samp, (local_batch,), 0,
+                                            local_n))
+        xs.append(x_all[a * local_n + idx])
+        ys.append(y_all[a * local_n + idx])
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+def test_pp_device_trajectory_matches_host_fed():
+    """Device-sampled chunked PP step == the host-fed PP step given the
+    same sampled batches: the input side moved into the program, the
+    pipeline math did not change."""
+    model = TransformerLM(**KW)
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(model, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=3)
+    data = put_device_data(ds, mesh, data_sharded=True)
+    B, T = 8, 2
+
+    dev = shard_state_pp(base, mesh)
+    dstep = make_pp_device_train_step(model, opt, mesh, B, 4,
+                                      keep_prob=1.0, chunk=T,
+                                      donate=False)
+    dev, m = dstep(dev, data)
+    assert np.isfinite(float(m["loss"]))
+
+    host = shard_state_pp(base, mesh)
+    hstep = make_pp_train_step(model, opt, mesh, microbatches=4,
+                               keep_prob=1.0, donate=False)
+    for _ in range(T):
+        rng = jax.device_get(host.rng)
+        batch = _sampled_global_batch(rng, ds, 2, B // 2)
+        host, mh = hstep(host, stage_batch_pp(mesh, batch))
+
+    np.testing.assert_allclose(float(m["loss"]), float(mh["loss"]),
+                               rtol=2e-5)
+    a_host = fetch_state_pp(host, model)
+    a_dev = fetch_state_pp(dev, model)
+    assert int(a_dev.step) == T
+    for a, b in zip(jax.tree.leaves(a_host.params),
+                    jax.tree.leaves(a_dev.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ep_device_trajectory_matches_host_fed():
+    """Device-sampled chunked EP step == the host-fed EP step given the
+    same sampled batches (per-shard routing groups identical: each data
+    shard routes the same rows in both paths)."""
+    model1 = TransformerLM(**MOE_KW)
+    modelP = TransformerLM(**MOE_KW, moe_axis=MODEL_AXIS)
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(model1, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=7)
+    data = put_device_data(ds, mesh, data_sharded=True)
+    B, T = 8, 2
+
+    dev = shard_state_ep(base, mesh)
+    dstep = make_ep_device_train_step(modelP, opt, mesh, B, keep_prob=1.0,
+                                      chunk=T, donate=False)
+    dev, m = dstep(dev, data)
+    assert np.isfinite(float(m["loss"]))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel.mesh import put_global
+
+    host = shard_state_ep(base, mesh)
+    hstep = make_ep_train_step(modelP, opt, mesh, keep_prob=1.0,
+                               donate=False)
+    specs = (NamedSharding(mesh, P("data", None)),
+             NamedSharding(mesh, P("data", None)))
+    for _ in range(T):
+        rng = jax.device_get(host.rng)
+        x, y = _sampled_global_batch(rng, ds, 2, B // 2)
+        host, mh = hstep(host, put_global(specs, (jnp.asarray(x),
+                                                  jnp.asarray(y))))
+
+    np.testing.assert_allclose(float(m["loss"]), float(mh["loss"]),
+                               rtol=2e-4)
+    assert int(jax.device_get(dev.step)) == T
+    for a, b in zip(jax.tree.leaves(jax.device_get(host.params)),
+                    jax.tree.leaves(jax.device_get(dev.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
+
+
+# ------------------------------------------- axis-correct clipping (advisor
+# high x2: stage/expert-local norms diverged the replicated leaves)
+
+
+def _assert_replicated_identical(arr):
+    shards = [np.asarray(s.data) for s in arr.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+
+def test_pp_clip_trajectory_matches_single_device():
+    """--clip_norm under PP: the axis-aware transform must reproduce the
+    single-device clipped trajectory EXACTLY (same global norm, same
+    scale), and the replicated leaves must stay bit-identical across the
+    model axis. clip_norm small enough that every step actually clips."""
+    model = TransformerLM(**KW)
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(model, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+
+    single = create_train_state(model, opt, seed=0)
+    step1 = make_train_step(model, opt, keep_prob=1.0, donate=False,
+                            grad_transform=clip_by_global_norm(0.05))
+    pp_state = shard_state_pp(base, mesh)
+    stepP = make_pp_train_step(model, opt, mesh, microbatches=4,
+                               keep_prob=1.0, donate=False,
+                               grad_transform=pp_clip_transform(0.05))
+
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=11)
+    for _ in range(3):
+        b = ds.next_batch(16)
+        single, m1 = step1(single, b)
+        pp_state, mP = stepP(pp_state, stage_batch_pp(mesh, b))
+    np.testing.assert_allclose(float(m1["loss"]), float(mP["loss"]),
+                               rtol=2e-5)
+    # the advisor-high failure mode: different per-stage scales would
+    # desynchronize the replicated copies — they must stay bit-identical
+    for leaf in (pp_state.params["tok"], pp_state.params["head"]["w"]):
+        _assert_replicated_identical(leaf)
+    host = fetch_state_pp(pp_state, model)
+    for a, b_ in zip(jax.tree.leaves(single.params),
+                     jax.tree.leaves(host.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ep_clip_trajectory_matches_single_device():
+    """--clip_norm under EP: axis-aware clip == single-device clipped MoE
+    trajectory (data=1: one routing group, exact standard), replicated
+    leaves bit-identical across the expert axis."""
+    model1 = TransformerLM(**MOE_KW)
+    modelP = TransformerLM(**MOE_KW, moe_axis=MODEL_AXIS)
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(model1, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=1, model=4), jax.devices()[:4])
+
+    single = create_train_state(model1, opt, seed=0)
+    step1 = make_train_step(model1, opt, keep_prob=1.0, donate=False,
+                            grad_transform=clip_by_global_norm(0.05))
+    ep_state = shard_state_ep(base, mesh)
+    stepP = make_ep_train_step(modelP, opt, mesh, keep_prob=1.0,
+                               donate=False,
+                               grad_transform=ep_clip_transform(0.05))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel.mesh import put_global
+
+    specs = (NamedSharding(mesh, P("data", None)),
+             NamedSharding(mesh, P("data", None)))
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=17)
+    for _ in range(3):
+        b = ds.next_batch(8)
+        single, m1 = step1(single, b)
+        ep_state, mP = stepP(ep_state, put_global(
+            specs, (jnp.asarray(b[0]), jnp.asarray(b[1]))))
+    np.testing.assert_allclose(float(m1["loss"]), float(mP["loss"]),
+                               rtol=2e-4)
+    for leaf in (ep_state.params["tok"],
+                 ep_state.params["blocks"][0]["moe"]["router"]):
+        _assert_replicated_identical(leaf)
+    for a, b_ in zip(jax.tree.leaves(single.params),
+                     jax.tree.leaves(jax.device_get(ep_state.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-4, atol=3e-5)
+
+
+# ------------------------------------ dispatch amortization + zero transfer
+
+
+def test_pp_device_one_dispatch_per_chunk_zero_transfer():
+    """One compiled call advances ``chunk`` steps, and after warmup the
+    dispatch moves NOTHING across the host boundary (the acceptance
+    contract: zero per-step host<->device transfer)."""
+    model = TransformerLM(**KW)
+    opt = get_optimizer("sgd", 0.05)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=5)
+    data = put_device_data(ds, mesh, data_sharded=True)
+    state = shard_state_pp(create_train_state(model, opt, seed=0), mesh)
+    step = make_pp_device_train_step(model, opt, mesh, 8, 4,
+                                     keep_prob=1.0, chunk=5)
+    state, _ = step(state, data)  # compile + weights upload
+    jax.block_until_ready(state.params)
+    with jax.transfer_guard("disallow"):
+        state, _ = step(state, data)  # steady state: pure dispatch
+    assert int(jax.device_get(state.step)) == 10  # 2 calls x chunk 5
+
+
+def test_ep_device_one_dispatch_per_chunk_zero_transfer():
+    model = TransformerLM(**MOE_KW, moe_axis=MODEL_AXIS)
+    opt = get_optimizer("sgd", 0.05)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=5)
+    data = put_device_data(ds, mesh, data_sharded=True)
+    state = shard_state_ep(
+        create_train_state(TransformerLM(**MOE_KW), opt, seed=0), mesh)
+    step = make_ep_device_train_step(model, opt, mesh, 8, keep_prob=1.0,
+                                     chunk=5)
+    state, _ = step(state, data)
+    jax.block_until_ready(state.params)
+    with jax.transfer_guard("disallow"):
+        state, _ = step(state, data)
+    assert int(jax.device_get(state.step)) == 10
+
+
+def test_put_device_data_sharded_layout_and_trim():
+    """data_sharded staging: example axis split over "data", replicated
+    over "model", remainder trimmed to the data ways."""
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    ds = LMDataSet(65, seq_len=32, vocab_size=16, seed=1)  # 65 -> trim 64
+    data = put_device_data(ds, mesh, data_sharded=True)
+    assert data.num_examples == 64
+    # each device holds half the examples (data-sharded), full seq axis
+    assert data.images.addressable_shards[0].data.shape == (32, 32)
+    starts = {s.index[0].start or 0 for s in data.images.addressable_shards}
+    assert starts == {0, 32}  # two data rows, each replicated 4x
+    # a split smaller than the data axis must refuse loudly, not trim
+    # to an empty resident dataset trained on garbage gathers
+    with pytest.raises(ValueError, match="cannot shard"):
+        put_device_data(LMDataSet(1, seq_len=32, vocab_size=16, seed=1),
+                        mesh, data_sharded=True)
+
+
+# ------------------------------------------------------- loop integration
+
+
+def _parse(flags, args):
+    flags.FLAGS._reset()
+    flags.FLAGS._parse(args)
+    return flags.FLAGS
+
+
+def test_device_pp_cli_end_to_end(tmp_path, monkeypatch):
+    """--device_data --pipeline through the production CLI (the guard
+    this PR removes): trains, clips, checkpoints in the STANDARD layout,
+    resumes — and dispatches exactly one compiled call per chunk."""
+    import glob
+
+    import distributed_tensorflow_tpu.training.device_step as dsmod
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    calls = {"n": 0}
+    orig = dsmod.make_pp_device_train_step
+
+    def counting(*a, **k):
+        fn = orig(*a, **k)
+
+        def wrapped(*aa, **kk):
+            calls["n"] += 1
+            return fn(*aa, **kk)
+
+        return wrapped
+
+    monkeypatch.setattr(dsmod, "make_pp_device_train_step", counting)
+    args = [f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+            "--dataset=lm", "--model=lm", "--pipeline", "--model_axis=4",
+            "--num_blocks=4", "--seq_len=32", "--vocab_size=16",
+            "--batch_size=16", "--display_step=3", "--device_data",
+            "--device_chunk=3", "--clip_norm=1.0", "--test_eval=false"]
+    try:
+        res = train(_parse(flags, args + ["--training_iter=6"]),
+                    mode="sync")
+        assert res.final_step == 6
+        assert np.isfinite(res.train_metrics["loss"])
+        assert calls["n"] == 2  # 6 steps / chunk 3: one dispatch each
+        assert glob.glob(f"{tmp_path}/logs/ckpt-*")
+        # resume from the standard-layout checkpoint
+        res2 = train(_parse(flags, args + ["--training_iter=9"]),
+                     mode="sync")
+        assert res2.final_step == 9
+    finally:
+        flags.FLAGS._reset()
+
+
+def test_device_ep_cli_end_to_end(tmp_path, monkeypatch):
+    """--device_data --expert_parallel through the production CLI (the
+    other removed guard): trains, clips, checkpoints, one dispatch per
+    chunk."""
+    import glob
+
+    import distributed_tensorflow_tpu.training.device_step as dsmod
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    calls = {"n": 0}
+    orig = dsmod.make_ep_device_train_step
+
+    def counting(*a, **k):
+        fn = orig(*a, **k)
+
+        def wrapped(*aa, **kk):
+            calls["n"] += 1
+            return fn(*aa, **kk)
+
+        return wrapped
+
+    monkeypatch.setattr(dsmod, "make_ep_device_train_step", counting)
+    try:
+        res = train(_parse(flags, [
+            f"--logdir={tmp_path}/logs", f"--data_dir={tmp_path}/none",
+            "--dataset=lm", "--model=lm", "--moe_experts=4",
+            "--expert_parallel", "--model_axis=4", "--seq_len=32",
+            "--vocab_size=16", "--batch_size=8", "--training_iter=6",
+            "--display_step=3", "--device_data", "--device_chunk=3",
+            "--clip_norm=1.0", "--test_eval=false"]), mode="sync")
+        assert res.final_step == 6
+        assert np.isfinite(res.train_metrics["loss"])
+        assert calls["n"] == 2
+        assert glob.glob(f"{tmp_path}/logs/ckpt-*")
+    finally:
+        flags.FLAGS._reset()

@@ -83,6 +83,21 @@ def test_switch_moe_capacity_drops_overflow():
     assert np.count_nonzero(np.abs(flat).sum(-1) > 1e-12) == 4
 
 
+def test_switch_moe_capacity_keeps_first_arrivals():
+    """Queue positions are FIRST-COME-FIRST-SERVED and integer-exact
+    (int32 cumsum — the f32 path lost integer exactness past 2^24
+    tokens/shard): with every token routed to one expert at capacity 4,
+    exactly the first 4 tokens in arrival order survive."""
+    params = _moe_params(jax.random.PRNGKey(0))
+    params["router"] = jnp.zeros((8, 4)).at[:, 2].set(100.0)
+    h = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (1, 16, 8))) + 0.1
+    y, aux = switch_moe(h, params, capacity_factor=1.0)
+    flat = np.asarray(y.reshape(16, 8))
+    nonzero = np.abs(flat).sum(-1) > 1e-12
+    np.testing.assert_array_equal(
+        nonzero, np.arange(16) < 4)  # first 4 arrivals, nothing else
+
+
 def test_moe_lm_trains_and_aux_loss_flows():
     """A MoE TransformerLM trains through the STANDARD step machinery
     (the loss hook adds the aux term in train mode only) and the lb

@@ -185,8 +185,10 @@ def test_pipeline_cli_rejections(tmp_path):
             train(parse("--model_axis=4", "--seq_parallel"), mode="sync")
         with pytest.raises(ValueError, match="stages nothing"):
             train(parse(), mode="sync")
-        with pytest.raises(ValueError, match="not supported"):
-            train(parse("--model_axis=4", "--device_data"), mode="sync")
+        # (--device_data composes as of r6: the resident PP sampler —
+        # tests/test_device_pp_ep.py pins that path end-to-end)
+        with pytest.raises(ValueError, match="augment"):
+            train(parse("--model_axis=4", "--augment"), mode="sync")
         with pytest.raises(ValueError, match="redundant"):
             train(parse("--model_axis=4", "--accum_steps=2"), mode="sync")
     finally:
