@@ -133,6 +133,16 @@ PP_EP_CHUNK = 10
 PP_EP_TIMED_CHUNKS = 3
 PP_EP_EXPERTS = 8
 
+# r7: the PP phase A/Bs the GPipe schedule against the interleaved
+# virtual-stage schedule (--virtual_stages, parallel/pp_schedule.py) in
+# the same session — 8 blocks so V=2 groups exist for both a 2- and a
+# 4-way stage axis (V*K must divide the block count). The schedule
+# facts (pp_schedule / pp_virtual_stages / pp_useful_tick_fraction) are
+# ANALYTIC and recorded even when the chip is unreachable, so the perf
+# trajectory keeps schedule-level evidence through tunnel outages.
+PP_NUM_BLOCKS = 8
+PP_VIRTUAL_STAGES = 2
+
 
 def _sync_every(n_chips: int) -> int:
     """In-flight collective-program cap (see utils.collective_sync_cadence
@@ -435,16 +445,47 @@ def lm_largevocab_phase() -> dict:
     return out
 
 
-def _ppep_model_ways(n_chips: int) -> int:
+def _ppep_model_ways(n_chips: int, num_blocks: int | None = None) -> int:
     """Model-axis width for the PP/EP device phases: the largest of
-    {4, 2} that divides both the chip count and the block/expert
-    layout; 0 = no model axis on this machine (phase skipped)."""
+    {4, 2} that divides the chip count and the block/expert layout
+    (``num_blocks`` defaults to the shared PP/EP constant; the PP phase
+    passes its own PP_NUM_BLOCKS so its divisibility guard tracks its
+    model); 0 = no model axis on this machine (phase skipped)."""
+    nb = PP_EP_NUM_BLOCKS if num_blocks is None else num_blocks
     for ways in (4, 2):
         if n_chips >= ways and n_chips % ways == 0 \
-                and PP_EP_NUM_BLOCKS % ways == 0 \
+                and nb % ways == 0 \
                 and PP_EP_EXPERTS % ways == 0:
             return ways
     return 0
+
+
+def _pp_virtual_stages(ways: int) -> int:
+    """Virtual-stage count for the PP phase's interleaved run: the
+    largest of {PP_VIRTUAL_STAGES, 1} whose K*V block groups divide the
+    phase model (microbatches = ways, so the V>1 round constraint
+    M % K == 0 holds by construction)."""
+    for v in (PP_VIRTUAL_STAGES, 1):
+        if PP_NUM_BLOCKS % (ways * v) == 0:
+            return v
+    return 1
+
+
+def _pp_schedule_facts(ways: int) -> dict:
+    """Analytic schedule facts for the PP phase config at ``ways``
+    stages (microbatches = ways): computable with NO chip, so outage
+    records still carry schedule-level evidence."""
+    from distributed_tensorflow_tpu.parallel.pp_schedule import (
+        build_pp_schedule,
+    )
+
+    v = _pp_virtual_stages(ways)
+    sched = build_pp_schedule(ways, ways, v)
+    return {
+        "pp_schedule": "interleaved" if v > 1 else "gpipe",
+        "pp_virtual_stages": v,
+        "pp_useful_tick_fraction": round(sched.useful_tick_fraction, 4),
+    }
 
 
 def _time_resident_chunks(chunk_fn, state, data, chunk: int,
@@ -464,18 +505,33 @@ def _time_resident_chunks(chunk_fn, state, data, chunk: int,
 
 
 def pp_device_phase(n_chips) -> dict:
-    """Pipeline parallelism over a DEVICE-RESIDENT split: the GPipe
-    stage ring (blocks staged over the model axis, microbatch scan +
+    """Pipeline parallelism over a DEVICE-RESIDENT split: the stage
+    ring (blocks staged over the model axis, schedule-table tick scan +
     ppermute) fed by on-device batch sampling with lax.scan chunking —
     zero host->device bytes per step, one dispatch per chunk
-    (training/device_step.make_pp_device_train_step). Reports
-    sequences/sec/chip as ``pp_images_per_sec_per_chip`` (the bench's
-    examples-rate convention); null fields on a 1-chip machine."""
-    ways = _ppep_model_ways(n_chips)
+    (training/device_step.make_pp_device_train_step). Runs a
+    same-session A/B of the two schedules: GPipe (V=1, reported as
+    ``pp_gpipe_images_per_sec_per_chip``) vs interleaved virtual
+    stages (--virtual_stages, the headline
+    ``pp_images_per_sec_per_chip``), with the analytic schedule facts
+    (``pp_schedule`` / ``pp_virtual_stages`` /
+    ``pp_useful_tick_fraction``) alongside. Rates are sequences/sec/
+    chip (the bench's examples-rate convention); null rate fields on a
+    1-chip machine — the schedule facts stay non-null (analytic).
+    NOTE: the phase model grew 4 -> 8 blocks in r7 (interleaving needs
+    V*K to divide the block count on both the 2- and 4-way axes), so
+    the pp_images_per_sec_per_chip series breaks at r7 — compare
+    within-record against the GPipe A/B number, not across rounds;
+    ``pp_device_num_blocks`` records the config."""
+    ways = _ppep_model_ways(n_chips, PP_NUM_BLOCKS)
     if not ways:
-        return {"pp_images_per_sec_per_chip": None,
-                "pp_device_skipped": f"no 2/4-way model axis over "
-                                     f"{n_chips} chip(s)"}
+        out = {"pp_images_per_sec_per_chip": None,
+               "pp_gpipe_images_per_sec_per_chip": None,
+               "pp_interleave_speedup": None,
+               "pp_device_skipped": f"no 2/4-way model axis over "
+                                    f"{n_chips} chip(s)"}
+        out.update(_pp_schedule_facts(2))  # 2-way fallback config
+        return out
     from distributed_tensorflow_tpu.data.device_data import put_device_data
     from distributed_tensorflow_tpu.data.lm import LMDataSet
     from distributed_tensorflow_tpu.models.transformer import TransformerLM
@@ -494,21 +550,32 @@ def pp_device_phase(n_chips) -> dict:
     batch = PP_EP_BATCH_PER_DATA_WAY * data_ways
     model = TransformerLM(
         vocab_size=PP_EP_VOCAB, seq_len=PP_EP_SEQ_LEN,
-        d_model=PP_EP_D_MODEL, num_heads=4, num_blocks=PP_EP_NUM_BLOCKS,
+        d_model=PP_EP_D_MODEL, num_heads=4, num_blocks=PP_NUM_BLOCKS,
         compute_dtype=jnp.bfloat16)
     opt = adam(1e-3)
     ds = LMDataSet(PP_EP_SPLIT, seq_len=PP_EP_SEQ_LEN,
                    vocab_size=PP_EP_VOCAB, seed=0)
     data = put_device_data(ds, mesh, data_sharded=True)
-    state = shard_state_pp(create_train_state(model, opt, seed=0), mesh)
-    fn = make_pp_device_train_step(model, opt, mesh, batch, ways,
-                                   keep_prob=1.0, chunk=PP_EP_CHUNK)
-    dt = _time_resident_chunks(fn, state, data, PP_EP_CHUNK,
-                               PP_EP_TIMED_CHUNKS, n_chips)
-    rate = PP_EP_TIMED_CHUNKS * PP_EP_CHUNK * batch / dt / n_chips
-    return {"pp_images_per_sec_per_chip": round(rate, 1),
-            "pp_device_stages": ways, "pp_device_chunk": PP_EP_CHUNK,
-            "pp_device_global_batch": batch}
+    base = create_train_state(model, opt, seed=0)
+    v_best = _pp_virtual_stages(ways)
+    rates = {}
+    for v in sorted({1, v_best}):
+        state = shard_state_pp(base, mesh, virtual_stages=v)
+        fn = make_pp_device_train_step(model, opt, mesh, batch, ways,
+                                       keep_prob=1.0, chunk=PP_EP_CHUNK,
+                                       virtual_stages=v)
+        dt = _time_resident_chunks(fn, state, data, PP_EP_CHUNK,
+                                   PP_EP_TIMED_CHUNKS, n_chips)
+        rates[v] = PP_EP_TIMED_CHUNKS * PP_EP_CHUNK * batch / dt / n_chips
+    out = {"pp_images_per_sec_per_chip": round(rates[v_best], 1),
+           "pp_gpipe_images_per_sec_per_chip": round(rates[1], 1),
+           "pp_interleave_speedup": (round(rates[v_best] / rates[1], 3)
+                                     if v_best > 1 else None),
+           "pp_device_stages": ways, "pp_device_chunk": PP_EP_CHUNK,
+           "pp_device_global_batch": batch,
+           "pp_device_num_blocks": PP_NUM_BLOCKS}
+    out.update(_pp_schedule_facts(ways))
+    return out
 
 
 def ep_device_phase(n_chips) -> dict:
@@ -798,6 +865,12 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
         "init_attempts": init_info.get("attempts"),
         "init_waited_s": init_info.get("waited_s"),
     }
+    # schedule-level facts are ANALYTIC (no chip required): the perf
+    # trajectory keeps pipeline-schedule evidence through tunnel
+    # outages (2-way fallback config — the chip count is unknowable
+    # here; `partial` overrides with the measured config when phases
+    # ran before the flap)
+    out.update(_pp_schedule_facts(2))
     if partial:
         out.update(partial)
     if cpu_smoke:

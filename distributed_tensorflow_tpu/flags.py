@@ -26,12 +26,22 @@ class _FlagValues:
         self.__dict__["_defs"] = {}  # name -> (type_fn, default, help)
         self.__dict__["_values"] = None
         self.__dict__["_extra_argv"] = []
+        self.__dict__["_validators"] = []  # fns(values) run after parse
 
     def _define(self, name: str, default, help_str: str, type_fn: Callable):
         if self._values is not None:
             # late definition after parse: make it visible with its default
             self._values[name] = default
         self._defs[name] = (type_fn, default, help_str)
+
+    def _register_validator(self, fn: Callable):
+        """Cross-flag consistency check run at PARSE time: ``fn(values)``
+        raises ValueError with an actionable message. This is how config
+        mistakes (e.g. a --virtual_stages/--num_blocks mismatch) surface
+        at the command line instead of minutes later mid-trace.
+        Idempotent by function identity."""
+        if fn not in self._validators:
+            self._validators.append(fn)
 
     def _parse(self, argv=None):
         parser = argparse.ArgumentParser(allow_abbrev=False)
@@ -52,6 +62,8 @@ class _FlagValues:
         )
         self.__dict__["_values"] = vars(ns)
         self.__dict__["_extra_argv"] = extra
+        for check in self._validators:
+            check(self._values)
         return extra
 
     def __getattr__(self, name: str) -> Any:
@@ -287,11 +299,26 @@ def define_reference_flags():
                    "(parallel/pipeline_parallel.py). Mutually exclusive "
                    "with --seq_parallel; num_blocks must divide by "
                    "--model_axis. Composes with --device_data (the "
-                   "resident chunked sampler) and --clip_norm (axis-"
-                   "aware)")
+                   "resident chunked sampler), --clip_norm (axis-"
+                   "aware) and --virtual_stages (the interleaved "
+                   "schedule — a ~V-fold smaller pipeline bubble)")
     DEFINE_integer("pp_microbatches", 0, "Microbatches per step under "
                    "--pipeline (0 = the stage count, the GPipe "
-                   "default); must divide the per-data-shard batch")
+                   "default); must divide the per-data-shard batch, "
+                   "and by --model_axis when --virtual_stages > 1 "
+                   "(the interleaved schedule works microbatches in "
+                   "rounds of the stage count)")
+    DEFINE_integer("virtual_stages", 1, "Interleaved virtual-stage "
+                   "pipeline schedule (Megatron-LM) for --pipeline: "
+                   "each stage owns this many NONCONTIGUOUS round-"
+                   "robin block groups, activations make V shorter "
+                   "trips around the ppermute ring, and the fill/"
+                   "drain bubble shrinks ~V-fold (useful-tick "
+                   "fraction M*V/(M*V+K-1) vs GPipe's M/(M+K-1)). "
+                   "Bit-identical trajectories to the default V=1; "
+                   "checkpoints stay layout-independent. Requires "
+                   "num_blocks divisible by model_axis*virtual_stages "
+                   "and microbatches divisible by model_axis")
     DEFINE_integer("moe_experts", 0, "If > 0, the LM's MLPs become "
                    "top-1 Switch mixture-of-experts layers with this "
                    "many experts (ops/moe.py); the training loss adds "
@@ -367,3 +394,51 @@ def define_reference_flags():
                    "written off-thread; training never blocks on the "
                    "disk). The final checkpoint on exit is always "
                    "synchronous")
+    FLAGS._register_validator(_validate_pipeline_flags)
+
+
+def _validate_pipeline_flags(values: dict):
+    """Parse-time pipeline-config validation: every constraint here used
+    to surface as a mid-trace ValueError from inside the compiled step
+    builder (parallel/pipeline_parallel._pp_step_fn) — catch it at the
+    command line with a message that names the flags instead. The
+    library-level checks stay (non-CLI callers are still protected);
+    this is the fail-fast front door."""
+    raw_v = values.get("virtual_stages")
+    v = 1 if raw_v is None else int(raw_v)
+    micro_flag = int(values.get("pp_microbatches") or 0)
+    if v < 1:
+        raise ValueError(f"--virtual_stages={v} must be >= 1")
+    if micro_flag < 0:
+        raise ValueError(f"--pp_microbatches={micro_flag} must be >= 0 "
+                         f"(0 = the stage count)")
+    if not values.get("pipeline"):
+        if v > 1:
+            raise ValueError(
+                f"--virtual_stages={v} only applies to --pipeline (the "
+                f"interleaved schedule splits pipeline stages); without "
+                f"--pipeline it would silently change nothing — drop it "
+                f"or add --pipeline")
+        return
+    k = int(values.get("model_axis") or 1)
+    micro = micro_flag or k
+    batch = int(values.get("batch_size") or 0)
+    if batch and micro and batch % micro:
+        raise ValueError(
+            f"--batch_size={batch} must split into "
+            f"--pp_microbatches={micro} microbatches (each data shard's "
+            f"slice must divide further — checked against the mesh at "
+            f"startup)")
+    if k > 1:  # model_axis<2 is rejected with its own message at startup
+        nb = int(values.get("num_blocks") or 0)
+        if nb % (k * v):
+            raise ValueError(
+                f"--num_blocks={nb} must divide into --model_axis={k} "
+                f"pipeline stages x --virtual_stages={v} block groups "
+                f"({k * v} total)")
+        if v > 1 and micro % k:
+            raise ValueError(
+                f"--virtual_stages={v} (interleaved schedule) works "
+                f"microbatches in rounds of the stage count: "
+                f"--pp_microbatches={micro} must be divisible by "
+                f"--model_axis={k}")

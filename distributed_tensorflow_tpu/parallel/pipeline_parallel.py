@@ -8,21 +8,32 @@ contiguous run of transformer blocks (a STAGE), the global batch splits
 into M microbatches, and activations flow stage-to-stage on the ring
 while every stage works on a different microbatch each tick.
 
-TPU-idiomatic formulation (no hand-written schedule, no host control):
+TPU-idiomatic formulation (static schedule table, no host control):
 
 - Stage parameters are the model's ``blocks`` list STACKED on a leading
   axis and sharded over "model" — each device holds (L, ...) leaves,
   L = num_blocks / K. ``stack_block_params`` / ``unstack_block_params``
   convert to/from the standard layout so CHECKPOINTS stay in the one
-  shared pytree format (SURVEY.md §7 hard part d).
-- One ``lax.scan`` over M + K - 1 ticks inside ``shard_map``. At tick
-  t, the device at stage s processes microbatch (t - s): stage 0
-  ingests (embeds) microbatch t, inner stages transform the activation
-  they received last tick, the last stage computes that microbatch's
-  loss contribution. One ``ppermute`` per tick moves activations to
-  the next stage. Out-of-range microbatch indices are masked with
-  ``where`` — every device runs the identical program (SPMD), and the
-  bubble ticks contribute exact zeros.
+  shared pytree format (SURVEY.md §7 hard part d). With
+  ``virtual_stages=V`` (interleaved schedule, Megatron-LM, Narayanan
+  et al. 2021) the stacking order is ROUND-ROBIN
+  (``pp_schedule.block_permutation``): device ``s`` owns the V
+  noncontiguous block groups ``s, s+K, ..., s+(V-1)K`` — checkpoints
+  still store the standard list order, so saves/restores are
+  layout-independent across V.
+- One ``lax.scan`` over ticks inside ``shard_map``, driven by the
+  static (K, M, V) tick table from ``pp_schedule.build_pp_schedule``:
+  at tick t, device s runs block group ``chunk_index[t, s]`` on
+  microbatch ``micro_index[t, s]`` (GPipe V=1: group 0, microbatch
+  t - s over M + K - 1 ticks; interleaved V>1: M*V + K - 1 ticks of
+  1/V-sized groups — the fill/drain bubble shrinks ~V-fold). Stage 0
+  ingests (embeds) a microbatch when its scheduled group is 0, the
+  last stage computes the loss when its scheduled group is V-1. One
+  ``ppermute`` per tick moves activations to the next stage — the
+  schedule satisfies T(m, j+1) = T(m, j) + 1, so a single carried
+  activation slot suffices for any V. Out-of-range ticks are masked —
+  every device runs the identical program (SPMD), and the bubble
+  ticks contribute exact zeros.
 - The BACKWARD pipeline is not written at all: reverse-mode AD of the
   scan + ppermute IS the backward schedule (ppermute's transpose is
   the reverse rotation, carrying output cotangents back through the
@@ -59,29 +70,43 @@ from distributed_tensorflow_tpu.models.transformer import (
 )
 from distributed_tensorflow_tpu.ops import nn
 from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from distributed_tensorflow_tpu.parallel.pp_schedule import (
+    block_permutation,
+    build_pp_schedule,
+    validate_pp_layout,
+)
 from distributed_tensorflow_tpu.training.train_state import (
     TrainState,
     apply_updates,
 )
 
 
-def stack_block_params(params):
+def stack_block_params(params, perm=None):
     """Standard layout (``blocks`` = list of per-block dicts) -> stacked
     (one dict whose leaves carry a leading num_blocks axis). Everything
     else passes through. The stacked form is what shards over the
-    stage axis; checkpoints always store the standard form."""
+    stage axis; checkpoints always store the standard form. ``perm``
+    (``pp_schedule.block_permutation``) reorders the stacking for the
+    interleaved layout — position p stores original block perm[p];
+    None keeps the contiguous GPipe order."""
     blocks = params["blocks"]
-    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    order = range(len(blocks)) if perm is None else perm
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
+                           *[blocks[int(b)] for b in order])
     out = dict(params)
     out["blocks"] = stacked
     return out
 
 
-def unstack_block_params(params, num_blocks: int):
-    """Inverse of ``stack_block_params`` (host-side: checkpoint fetch)."""
+def unstack_block_params(params, num_blocks: int, perm=None):
+    """Inverse of ``stack_block_params`` (host-side: checkpoint fetch):
+    returns the standard list order whatever stacking order ``perm``
+    produced the stacked array."""
     stacked = params["blocks"]
-    blocks = [jax.tree.map(lambda x: x[i], stacked)
-              for i in range(num_blocks)]
+    pos_of = (range(num_blocks) if perm is None
+              else {int(b): p for p, b in enumerate(perm)})
+    blocks = [jax.tree.map(lambda x, i=pos_of[b]: x[i], stacked)
+              for b in range(num_blocks)]
     out = dict(params)
     out["blocks"] = blocks
     return out
@@ -124,20 +149,59 @@ def is_stage_leaf(path) -> bool:
     return keys[:1] == ("blocks",)
 
 
-def pp_clip_transform(max_norm: float):
+def pp_clip_transform(max_norm: float, virtual_stages: int = 1):
     """Axis-correct global-norm clip for INSIDE the PP ``shard_map``
-    step: stage-sharded block leaves contribute their local squares as
-    exact partials, replicated leaves count once, the squared norm
-    ``psum``s over the stage axis, and every device applies the SAME
-    scale — so replicated leaves (tok/pos/ln_f/head) stay bit-identical
-    across stages (the stage-local-norm divergence the plain
-    ``clip_by_global_norm`` had here)."""
-    from distributed_tensorflow_tpu.training.train_state import (
-        clip_by_global_norm,
-    )
+    step: stage-sharded block leaves contribute exact partials of the
+    squared norm, replicated leaves count once, and every device
+    applies the SAME scale — so replicated leaves (tok/pos/ln_f/head)
+    stay bit-identical across stages (the stage-local-norm divergence
+    the plain ``clip_by_global_norm`` had here).
 
-    return clip_by_global_norm(max_norm, axis=MODEL_AXIS,
-                               sharded_leaf=is_stage_leaf)
+    The block contribution is accumulated in CANONICAL (original block
+    index) order: each device computes a per-block-slot squared-sum
+    vector, scatters it into the block's original position (undoing the
+    ``virtual_stages`` round-robin permutation), and one ``psum``
+    assembles the full [num_blocks] vector — each slot has exactly one
+    nonzero contributor, so the psum is order-exact, and the final
+    reduction runs over the same vector whatever the layout. That makes
+    the clipped trajectory BIT-IDENTICAL across V (the V=2 == V=1
+    exactness tests/test_pp_interleaved.py pins); a per-device psum of
+    differently-grouped partials would wobble in the last ulp."""
+    max_norm = float(max_norm)
+    v = int(virtual_stages)
+
+    def transform(grads):
+        k = lax.axis_size(MODEL_AXIS)
+        s_idx = lax.axis_index(MODEL_AXIS)
+        flat = jax.tree_util.tree_flatten_with_path(grads)[0]
+        per_slot = None  # [L] squared sums, summed across block leaves
+        rep = []
+        for path, g in flat:
+            sq = jnp.square(g.astype(jnp.float32))
+            if is_stage_leaf(path):
+                slot = jnp.sum(sq.reshape(sq.shape[0], -1), axis=1)
+                per_slot = slot if per_slot is None else per_slot + slot
+            else:
+                rep.append(jnp.sum(sq))
+        total = jnp.float32(0.0)
+        if per_slot is not None:
+            local = per_slot.shape[0]
+            group = local // v
+            # original block index of each local slot (stacked position
+            # s_idx*L + vg*group + l holds block (vg*k + s_idx)*group + l)
+            orig = ((jnp.arange(v)[:, None] * k + s_idx) * group
+                    + jnp.arange(group)[None, :]).reshape(local)
+            vec = jnp.zeros((local * k,), jnp.float32).at[orig].set(per_slot)
+            total = total + jnp.sum(lax.psum(vec, MODEL_AXIS))
+        # replicated-leaf grads are psum results — identical on every
+        # stage already, so adding them locally keeps one scale everywhere
+        for r in rep:
+            total = total + r
+        norm = jnp.sqrt(total)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+        return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+
+    return transform
 
 
 def pp_state_specs(state: TrainState) -> TrainState:
@@ -159,26 +223,44 @@ def pp_state_specs(state: TrainState) -> TrainState:
                                                state.model_state))
 
 
-def shard_state_pp(state: TrainState, mesh) -> TrainState:
-    """Stack the blocks list and place the state with the PP layout."""
-    stacked = state._replace(params=stack_block_params(state.params))
+def shard_state_pp(state: TrainState, mesh,
+                   virtual_stages: int = 1) -> TrainState:
+    """Stack the blocks list (round-robin order under
+    ``virtual_stages > 1``) and place the state with the PP layout."""
+    perm = None
+    if int(virtual_stages) > 1:
+        perm = block_permutation(len(state.params["blocks"]),
+                                 mesh.shape[MODEL_AXIS], virtual_stages)
+    stack = lambda p: stack_block_params(p, perm)
+    stacked = state._replace(params=stack(state.params))
     stacked = stacked._replace(opt_state=_map_params_shaped(
         state.opt_state, jax.tree.structure(state.params),
-        stack_block_params, lambda e: e))
+        stack, lambda e: e))
     return jax.device_put(stacked, pp_state_sharding(stacked, mesh))
 
 
-def fetch_state_pp(state: TrainState, model) -> TrainState:
+def fetch_state_pp(state: TrainState, model, k_stages: int | None = None,
+                   virtual_stages: int = 1) -> TrainState:
     """PP-layout state -> host state in the STANDARD layout (checkpoint
-    format): unstack blocks in params and any params-shaped opt slots."""
+    format): unstack blocks in params and any params-shaped opt slots,
+    undoing the ``virtual_stages`` round-robin stacking (``k_stages``
+    is required for V > 1) — so checkpoints are identical whatever
+    (K, V) layout the run trained under."""
     host = jax.device_get(state)
     n = model.num_blocks
-    params = unstack_block_params(host.params, n)
+    perm = None
+    if int(virtual_stages) > 1:
+        if k_stages is None:
+            raise ValueError("fetch_state_pp needs k_stages to invert "
+                             "the virtual_stages>1 stacking order")
+        perm = block_permutation(n, k_stages, virtual_stages)
+    unstack = lambda p: unstack_block_params(p, n, perm)
+    params = unstack(host.params)
     return host._replace(
         params=params,
         opt_state=_map_params_shaped(
             host.opt_state, jax.tree.structure(host.params),
-            lambda e: unstack_block_params(e, n), lambda e: e))
+            unstack, lambda e: e))
 
 
 def _attn_for(model):
@@ -197,7 +279,8 @@ def _attn_for(model):
 
 
 def _pp_step_fn(model, optimizer, mesh, microbatches: int,
-                keep_prob: float, grad_transform):
+                keep_prob: float, grad_transform,
+                virtual_stages: int = 1):
     """Validate the PP configuration and build the raw per-shard step
     ``(state, (x, y)) -> (state, metrics)`` — the body both the host-fed
     wrapper (``make_pp_train_step``) and the device-resident sampler
@@ -213,12 +296,11 @@ def _pp_step_fn(model, optimizer, mesh, microbatches: int,
                          "form and would drop the aux loss); use "
                          "--expert_parallel for MoE sharding")
     k_stages = mesh.shape[MODEL_AXIS]
-    if model.num_blocks % k_stages:
-        raise ValueError(
-            f"num_blocks={model.num_blocks} must divide into "
-            f"{k_stages} pipeline stages")
-    cd = model.compute_dtype
     m = int(microbatches)
+    v_stages = int(virtual_stages)
+    validate_pp_layout(model.num_blocks, k_stages, v_stages,
+                       microbatches=m)
+    cd = model.compute_dtype
 
     def step(state: TrainState, batch):
         x, y = batch
@@ -231,7 +313,7 @@ def _pp_step_fn(model, optimizer, mesh, microbatches: int,
 
         def loss_fn(params):
             return _pp_loss(model, params, x, y, sub, m, k_stages,
-                            s_idx, keep_prob, cd)
+                            s_idx, keep_prob, cd, v_stages)
 
         grads, (loss, acc) = jax.grad(loss_fn, has_aux=True)(state.params)
         # the differentiated loss was LOCAL (nonzero on the last stage
@@ -264,7 +346,7 @@ def _pp_step_fn(model, optimizer, mesh, microbatches: int,
 
 def make_pp_train_step(model, optimizer, mesh, microbatches: int,
                        keep_prob: float = 1.0, donate: bool = True,
-                       grad_transform=None):
+                       grad_transform=None, virtual_stages: int = 1):
     """Compiled pipeline-parallel train step for ``TransformerLM``:
     (PP-layout state, staged batch) -> (state, metrics).
 
@@ -272,10 +354,14 @@ def make_pp_train_step(model, optimizer, mesh, microbatches: int,
     (M) must divide the per-data-shard batch. The model must be a plain
     (seq_axis=None) LM — attention flavors (dense or ``attn_block``)
     and the streamed CE head (``ce_block``) all work; blocks split K
-    ways. Matches ``compute_grads(accum_steps=M)`` trajectories (the
+    ways. ``virtual_stages=V`` runs the interleaved schedule on a
+    state stacked by ``shard_state_pp(..., virtual_stages=V)`` —
+    bit-identical trajectories to V=1, in M*V + K - 1 ticks of
+    1/V-sized block groups instead of M + K - 1 full-stage ticks.
+    Matches ``compute_grads(accum_steps=M)`` trajectories (the
     per-microbatch rng fold is the same)."""
     step = _pp_step_fn(model, optimizer, mesh, microbatches, keep_prob,
-                       grad_transform)
+                       grad_transform, virtual_stages)
     data_spec = (P(DATA_AXIS, None), P(DATA_AXIS, None))
     cache: dict = {}
 
@@ -294,9 +380,15 @@ def make_pp_train_step(model, optimizer, mesh, microbatches: int,
     return call
 
 
-def _pp_loss(model, params, x, y, sub, m, k_stages, s_idx, keep_prob, cd):
+def _pp_loss(model, params, x, y, sub, m, k_stages, s_idx, keep_prob, cd,
+             v_stages: int = 1):
     """The pipelined forward + loss (see module docstring): returns
-    (global mean loss, (loss, accuracy)) — grad'd with has_aux."""
+    (global mean loss, (loss, accuracy)) — grad'd with has_aux. The
+    tick loop is driven by the static (K, M, V) schedule table; V=1 is
+    exactly the GPipe schedule, V>1 the interleaved one. Per-microbatch
+    PRNG folds and the masked-mean loss are identical for every V — the
+    forward applies the same blocks to the same microbatches in the
+    same order, so trajectories are bit-identical across V."""
     tok, pos = params["tok"], params["pos"]
     blocks = params["blocks"]
     lnf, head = params["ln_f"], params["head"]
@@ -311,14 +403,28 @@ def _pp_loss(model, params, x, y, sub, m, k_stages, s_idx, keep_prob, cd):
         # block's activations live at a time, recompute in the backward
         blk_fn = jax.checkpoint(_transformer_block, static_argnums=(2, 3))
 
+    sched = build_pp_schedule(k_stages, m, v_stages)
+    chunk_tbl = jnp.asarray(sched.chunk_index)  # [T, K]
+    mb_tbl = jnp.asarray(sched.micro_index)     # [T, K] (pre-clipped)
+    valid_tbl = jnp.asarray(sched.valid)        # [T, K]
+    # local shard: [L, ...] leaves -> [V, L/V, ...] virtual-stage groups
+    # (group v on device s holds the blocks of virtual stage v*K + s —
+    # the round-robin stacking order of shard_state_pp)
+    vblocks = jax.tree.map(
+        lambda a: a.reshape(v_stages, a.shape[0] // v_stages,
+                            *a.shape[1:]),
+        blocks)
+
     def embed(ids):
         h = jnp.take(tok, ids, axis=0) + pos.astype(tok.dtype)
         return h.astype(cd) if cd is not None else h
 
-    def stage(h):
-        def body(h, blk):
-            return blk_fn(h, blk, attn, cd), None
-        h, _ = lax.scan(body, h, blocks)
+    def group_fwd(h, v):
+        blk = jax.tree.map(lambda a: a[v], vblocks)
+
+        def body(h, b):
+            return blk_fn(h, b, attn, cd), None
+        h, _ = lax.scan(body, h, blk)
         return h
 
     def head_loss(h, targets, key):
@@ -335,31 +441,31 @@ def _pp_loss(model, params, x, y, sub, m, k_stages, s_idx, keep_prob, cd):
                 nn.accuracy(logits, targets))
 
     def tick(carry, t):
-        # embed/head are GATED with lax.cond on the stage index, not
-        # computed-then-masked: K-1 of K stages would otherwise burn
+        # embed/head are GATED with lax.cond on the scheduled unit, not
+        # computed-then-masked: other stages/groups would otherwise burn
         # the full vocab-head FLOPs every tick — at large V (the
         # ce_block composition) that is comparable to a block's cost
         # and would eat the pipeline speedup
         h_cur = carry
+        v = chunk_tbl[t, s_idx]
+        mb_i = mb_tbl[t, s_idx]
+        ok = valid_tbl[t, s_idx]
         h_in = lax.cond(
-            s_idx == 0,
-            lambda: embed(xm[jnp.clip(t, 0, m - 1)]).astype(h_cur.dtype),
+            (s_idx == 0) & (v == 0),
+            lambda: embed(xm[mb_i]).astype(h_cur.dtype),
             lambda: h_cur)
-        h_out = stage(h_in)
-        mb_i = t - (k_stages - 1)
-        valid_mb = (mb_i >= 0) & (mb_i < m)
+        h_out = group_fwd(h_in, v)
         loss, acc = lax.cond(
-            (s_idx == k_stages - 1) & valid_mb,
-            lambda: head_loss(h_out, ym[jnp.clip(mb_i, 0, m - 1)],
-                              jax.random.fold_in(
-                                  sub, jnp.clip(mb_i, 0, m - 1))),
+            (s_idx == k_stages - 1) & (v == v_stages - 1) & ok,
+            lambda: head_loss(h_out, ym[mb_i],
+                              jax.random.fold_in(sub, mb_i)),
             lambda: (jnp.float32(0.0), jnp.float32(0.0)))
         h_next = lax.ppermute(h_out, MODEL_AXIS, perm)
         return h_next, (loss, acc)
 
     h0 = jnp.zeros((mb, x.shape[1], model.d_model),
                    cd if cd is not None else jnp.float32)
-    _, (losses, accs) = lax.scan(tick, h0, jnp.arange(m + k_stages - 1))
+    _, (losses, accs) = lax.scan(tick, h0, jnp.arange(sched.num_ticks))
     # LOCAL loss only — no psum inside the differentiated function.
     # Grad seeds cotangent 1.0 on the last stage's (only nonzero) local
     # loss; the ppermute transposes route that backward through earlier

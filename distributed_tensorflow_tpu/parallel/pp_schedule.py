@@ -1,0 +1,193 @@
+"""Static tick schedules for SPMD pipeline parallelism.
+
+The pipeline step (parallel/pipeline_parallel.py) is one ``lax.scan``
+over TICKS inside ``shard_map``: at every tick each device runs exactly
+one block-group computation (possibly masked) and one ``ppermute`` moves
+activations to the next stage. Because out-of-range work is MASKED, not
+skipped, every scheduled tick costs full block-group FLOPs — so the
+schedule table below IS the cost model, and shrinking it is the whole
+performance story:
+
+- **GPipe** (V=1, Huang et al. 2019): device ``s`` owns one contiguous
+  run of blocks; at tick ``t`` it works microbatch ``t - s``. Length
+  ``M + K - 1`` ticks of full-stage work, so the useful-compute
+  fraction is ``M / (M + K - 1)`` — at K=4, M=4 half of every step is
+  masked bubble.
+
+- **Interleaved virtual stages** (V>1, Megatron-LM, Narayanan et al.
+  2021): device ``s`` owns V NONCONTIGUOUS block groups ("virtual
+  stages" ``s, s+K, ..., s+(V-1)K`` of ``V*K`` total), each 1/V the
+  size. A microbatch makes V trips around the ring; microbatches are
+  processed in rounds of K (so ``K | M``), and within a round a device
+  cycles through its V groups. Work unit (microbatch ``m = g*K + i``,
+  virtual stage ``j = v*K + s``) runs on device ``s`` at tick
+
+      T(m, j) = j + g*V*K + i
+
+  which is a bijection per (device, tick), satisfies the dataflow
+  dependency ``T(m, j+1) = T(m, j) + 1`` (every activation produced at
+  a tick is consumed exactly one tick later on the next ring neighbor
+  — ONE carried activation slot suffices), and packs the whole step
+  into ``M*V + K - 1`` ticks of 1/V-sized work. Useful fraction:
+  ``M*V / (M*V + K - 1)`` = ``M / (M + (K-1)/V)`` — the fill/drain
+  bubble shrinks ~V-fold.
+
+Everything here is host-side numpy: the tables are closed over as
+constants by the compiled step, printed by ``tools/trace_ops.py
+--schedule``, recorded analytically by ``bench.py`` (even when the TPU
+is unreachable), and pinned by tests/test_pp_interleaved.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class PPSchedule:
+    """The static tick table for a (K stages, M microbatches, V virtual
+    stages) pipeline. Arrays are indexed ``[tick, stage]``:
+
+    - ``chunk_index``: which of the device's V local block groups runs
+      (0 always when V=1).
+    - ``micro_index``: which microbatch that group works, clipped to
+      ``[0, M-1]`` on bubble ticks (the masked computation still needs
+      an in-range gather index).
+    - ``valid``: False on bubble (masked) ticks — their results are
+      exact zeros and contribute nothing to loss or gradients.
+    """
+
+    k_stages: int
+    microbatches: int
+    virtual_stages: int
+    num_ticks: int
+    chunk_index: np.ndarray  # [T, K] int32
+    micro_index: np.ndarray  # [T, K] int32, clipped
+    valid: np.ndarray        # [T, K] bool
+
+    @property
+    def useful_tick_fraction(self) -> float:
+        """Per-stage fraction of ticks doing unmasked work:
+        ``M*V / (M*V + K - 1)`` — every stage has exactly M*V valid
+        ticks of the schedule's T."""
+        return self.microbatches * self.virtual_stages / self.num_ticks
+
+    def scheduled_block_computations(self, num_blocks: int) -> int:
+        """Total transformer-block executions per step across all
+        stages (masked ticks included — they cost the same FLOPs).
+        GPipe at K=2, M=8 runs 9*num_blocks; V=2 runs 8.5*num_blocks."""
+        group = num_blocks // (self.k_stages * self.virtual_stages)
+        return self.num_ticks * self.k_stages * group
+
+
+def validate_pp_layout(num_blocks: int, k_stages: int,
+                       virtual_stages: int = 1,
+                       microbatches: int | None = None) -> None:
+    """The one statement of the pipeline layout constraints, shared by
+    flag parsing, the loop, and the step builder — raises ValueError
+    with an actionable message instead of a mid-trace failure."""
+    v = int(virtual_stages)
+    if v < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {v}")
+    if num_blocks % (k_stages * v):
+        raise ValueError(
+            f"num_blocks={num_blocks} must divide into {k_stages} "
+            f"pipeline stages x {v} virtual stage group(s) "
+            f"({k_stages * v} block groups total)")
+    if v > 1 and microbatches is not None and microbatches % k_stages:
+        raise ValueError(
+            f"the interleaved schedule (virtual_stages={v}) processes "
+            f"microbatches in rounds of the stage count: "
+            f"pp_microbatches={microbatches} must be divisible by "
+            f"{k_stages}")
+
+
+def build_pp_schedule(k_stages: int, microbatches: int,
+                      virtual_stages: int = 1) -> PPSchedule:
+    """Build the static [T, K] tick tables (module docstring has the
+    derivation). V=1 reduces exactly to the GPipe schedule the V<2 code
+    always ran: chunk 0 everywhere, microbatch ``t - s``."""
+    k = int(k_stages)
+    m = int(microbatches)
+    v = int(virtual_stages)
+    if k < 1 or m < 1:
+        raise ValueError(f"need k_stages >= 1 and microbatches >= 1, "
+                         f"got K={k}, M={m}")
+    if v < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {v}")
+    if v > 1 and m % k:
+        raise ValueError(
+            f"the interleaved schedule processes microbatches in rounds "
+            f"of the stage count: M={m} must be divisible by K={k}")
+    num_ticks = m * v + k - 1
+    t = np.arange(num_ticks, dtype=np.int64)[:, None]
+    s = np.arange(k, dtype=np.int64)[None, :]
+    u = t - s  # device s's work counter at tick t
+    valid = (u >= 0) & (u < m * v)
+    uc = np.clip(u, 0, m * v - 1)
+    chunk = (uc % (v * k)) // k
+    micro = (uc // (v * k)) * k + uc % k
+    return PPSchedule(
+        k_stages=k, microbatches=m, virtual_stages=v,
+        num_ticks=num_ticks,
+        chunk_index=chunk.astype(np.int32),
+        micro_index=np.clip(micro, 0, m - 1).astype(np.int32),
+        valid=valid,
+    )
+
+
+def block_permutation(num_blocks: int, k_stages: int,
+                      virtual_stages: int = 1) -> np.ndarray:
+    """Stacked-layout block order: ``perm[p]`` is the ORIGINAL block
+    index stored at stacked position ``p``. The stacked leading axis
+    splits contiguously over the stage axis (device ``s`` holds
+    positions ``[s*L, (s+1)*L)``, ``L = num_blocks/K``); within that,
+    group ``v`` holds the blocks of virtual stage ``v*K + s`` — the
+    round-robin assignment that makes one ring hop per tick carry
+    activations between consecutive virtual stages. Identity for V=1,
+    so the GPipe layout (and every existing checkpoint path) is the
+    V=1 special case."""
+    validate_pp_layout(num_blocks, k_stages, virtual_stages)
+    k, v = int(k_stages), int(virtual_stages)
+    lv = num_blocks // (k * v)
+    perm = np.empty(num_blocks, dtype=np.int64)
+    p = 0
+    for s_dev in range(k):
+        for vg in range(v):
+            base = (vg * k + s_dev) * lv
+            perm[p:p + lv] = np.arange(base, base + lv)
+            p += lv
+    return perm
+
+
+def format_schedule(sched: PPSchedule) -> str:
+    """Human-readable tick table (``tools/trace_ops.py --schedule``):
+    one row per tick, one column per stage, cells ``mM.vV`` (microbatch,
+    virtual-stage group) or ``--`` for masked bubble ticks."""
+    k, m, v = sched.k_stages, sched.microbatches, sched.virtual_stages
+    lines = [
+        f"pipeline schedule: K={k} stages, M={m} microbatches, "
+        f"V={v} virtual stage group(s) per device "
+        f"({'interleaved' if v > 1 else 'gpipe'})",
+        f"ticks per step: {sched.num_ticks} "
+        f"(useful {m * v}, bubble {k - 1})",
+        f"useful-tick fraction per stage: "
+        f"{sched.useful_tick_fraction:.4f}  "
+        f"[M*V/(M*V+K-1); gpipe baseline "
+        f"{m / (m + k - 1):.4f}]",
+        "",
+        "tick | " + " | ".join(f"stage {s}" for s in range(k)),
+    ]
+    lines.append("-----+-" + "-+-".join("-" * 7 for _ in range(k)))
+    for t in range(sched.num_ticks):
+        cells = []
+        for s in range(k):
+            if sched.valid[t, s]:
+                cells.append(f"m{sched.micro_index[t, s]}.v"
+                             f"{sched.chunk_index[t, s]}".ljust(7))
+            else:
+                cells.append("--".ljust(7))
+        lines.append(f"{t:4d} | " + " | ".join(cells))
+    return "\n".join(lines)

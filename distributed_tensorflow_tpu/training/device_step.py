@@ -258,7 +258,8 @@ def _make_resident_sharded_step(per_shard_step, state_specs_fn, mesh,
 def make_pp_device_train_step(model, optimizer, mesh, batch_size: int,
                               microbatches: int, *, keep_prob: float = 1.0,
                               chunk: int = 1, donate: bool = True,
-                              grad_transform=None):
+                              grad_transform=None,
+                              virtual_stages: int = 1):
     """Pipeline-parallel chunked step over device-resident data — the
     GPipe schedule composed with the zero-host-bytes input path. The
     split lives DATA-SHARDED in HBM (``put_device_data(...,
@@ -268,10 +269,13 @@ def make_pp_device_train_step(model, optimizer, mesh, batch_size: int,
     axis index ONLY — every stage of a data row draws the SAME rows, so
     its gather yields exactly its per-shard batch with no collective on
     the input side. The rest is the PP train step verbatim
-    (parallel/pipeline_parallel._pp_step_fn: microbatch scan + ppermute
-    ring, psum'd replicated-leaf grads), and ``lax.scan`` runs ``chunk``
-    steps per dispatch. ``grad_transform`` composes inside the step —
-    pass ``pp_clip_transform`` for an axis-correct --clip_norm."""
+    (parallel/pipeline_parallel._pp_step_fn: schedule-table tick scan +
+    ppermute ring, psum'd replicated-leaf grads), and ``lax.scan`` runs
+    ``chunk`` steps per dispatch. ``grad_transform`` composes inside the
+    step — pass ``pp_clip_transform`` for an axis-correct --clip_norm.
+    ``virtual_stages=V`` selects the interleaved schedule (state stacked
+    by ``shard_state_pp(..., virtual_stages=V)``; bit-identical
+    trajectories to V=1 with a ~V-fold smaller pipeline bubble)."""
     from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
         _pp_step_fn,
         pp_state_specs,
@@ -288,7 +292,7 @@ def make_pp_device_train_step(model, optimizer, mesh, batch_size: int,
             f"per-shard batch {local_batch} must split into "
             f"{microbatches} microbatches")
     pp_step = _pp_step_fn(model, optimizer, mesh, microbatches, keep_prob,
-                          grad_transform)
+                          grad_transform, virtual_stages)
     return _make_resident_sharded_step(pp_step, pp_state_specs, mesh,
                                        local_batch, chunk, donate)
 

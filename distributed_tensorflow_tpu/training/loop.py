@@ -977,9 +977,14 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
     mode's documented trade — the per-step host batch the reference's
     pre-update eval wants would stall the pipeline). --clip_norm runs
     the AXIS-AWARE transform (pp_clip_transform): the squared norm
-    psums over the stage axis before scaling, so replicated leaves
-    stay bit-identical across stages. With --device_data the split
-    stages data-sharded into HBM and the chunked sampler
+    assembles in canonical block order over the stage axis before
+    scaling, so replicated leaves stay bit-identical across stages (and
+    trajectories across --virtual_stages layouts). --virtual_stages V
+    runs the INTERLEAVED schedule (parallel/pp_schedule.py): each
+    device owns V round-robin block groups and the fill/drain bubble
+    shrinks ~V-fold — same math, bit-identical to V=1; checkpoints
+    stay in the standard layout whatever V. With --device_data the
+    split stages data-sharded into HBM and the chunked sampler
     (_train_pipeline_device) replaces the host-fed loop."""
     from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
     from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS
@@ -989,6 +994,9 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
         pp_clip_transform,
         shard_state_pp,
         stage_batch_pp,
+    )
+    from distributed_tensorflow_tpu.parallel.pp_schedule import (
+        validate_pp_layout,
     )
 
     if ds.meta.get("kind") != "lm":
@@ -1011,12 +1019,17 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
                          "microbatching IS the pipeline schedule — set "
                          "--pp_microbatches instead")
 
-    clip = (pp_clip_transform(FLAGS.clip_norm)
+    vstages = max(1, int(getattr(FLAGS, "virtual_stages", 1)))
+    micro = int(getattr(FLAGS, "pp_microbatches", 0)) or model_axis
+    # layout constraints up front (clear errors instead of mid-trace):
+    # K*V must divide the blocks, and V>1 schedules microbatch rounds of K
+    validate_pp_layout(model.num_blocks, model_axis, vstages,
+                       microbatches=micro)
+    clip = (pp_clip_transform(FLAGS.clip_norm, virtual_stages=vstages)
             if getattr(FLAGS, "clip_norm", 0.0) > 0 else None)
     mesh = make_mesh(MeshSpec(data=-1, model=model_axis))
     n_chips = mesh.devices.size
     data_ways = mesh.shape[DATA_AXIS]
-    micro = int(getattr(FLAGS, "pp_microbatches", 0)) or model_axis
     if FLAGS.batch_size % data_ways:
         raise ValueError(f"--batch_size={FLAGS.batch_size} must divide "
                          f"over the {data_ways}-way data axis")
@@ -1027,11 +1040,12 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
 
     if getattr(FLAGS, "device_data", False):
         return _train_pipeline_device(FLAGS, ds, model, opt, state, mesh,
-                                      n_chips, micro, clip)
+                                      n_chips, micro, clip, vstages)
 
     step_fn = make_pp_train_step(model, opt, mesh, micro,
                                  keep_prob=FLAGS.keep_prob,
-                                 grad_transform=clip)
+                                 grad_transform=clip,
+                                 virtual_stages=vstages)
     sv = Supervisor(
         is_chief=(FLAGS.task_index == 0),
         logdir=FLAGS.logdir,
@@ -1051,7 +1065,7 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
     with sv.managed(state) as box:
         step = box.step
         periodic_eval.prime(step)
-        pp_state = shard_state_pp(box.state, mesh)
+        pp_state = shard_state_pp(box.state, mesh, virtual_stages=vstages)
         compile_done = False
         meter.reset()
         while not sv.should_stop() and step < FLAGS.training_iter:
@@ -1067,7 +1081,9 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
                         or (eval_every and step % eval_every == 0)
                         or sv.checkpointer.cadence_due())
             if boundary:
-                host = fetch_state_pp(pp_state, model)
+                host = fetch_state_pp(pp_state, model,
+                                      k_stages=model_axis,
+                                      virtual_stages=vstages)
                 box.update(host, step)
                 if step % FLAGS.display_step == 0:
                     last_display = {k: float(v) for k, v in m.items()}
@@ -1078,7 +1094,8 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
                 periodic_eval(host, step)
                 sv.maybe_checkpoint(host, step)
         jax.block_until_ready(pp_state.params)
-        host = fetch_state_pp(pp_state, model)
+        host = fetch_state_pp(pp_state, model, k_stages=model_axis,
+                              virtual_stages=vstages)
         box.update(host, step)
 
     test_metrics = _final_test_eval(FLAGS, sv, periodic_eval, model, host,
@@ -1096,7 +1113,7 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
 
 
 def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
-                           micro, clip) -> TrainResult:
+                           micro, clip, vstages: int = 1) -> TrainResult:
     """--pipeline --device_data: the GPipe stage ring over a DEVICE-
     RESIDENT split. The split stages data-sharded into HBM once
     (``put_device_data(..., data_sharded=True)``); every step samples
@@ -1113,6 +1130,7 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
     import math
 
     from distributed_tensorflow_tpu.data.device_data import put_device_data
+    from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
     from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
         fetch_state_pp,
         shard_state_pp,
@@ -1121,6 +1139,7 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
         make_pp_device_train_step,
     )
 
+    k_stages = mesh.shape[MODEL_AXIS]
     data = put_device_data(ds.train, mesh, data_sharded=True)
     chunk = max(1, math.gcd(FLAGS.display_step, max(1, FLAGS.device_chunk)))
     if chunk != FLAGS.device_chunk:
@@ -1136,7 +1155,7 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
             fn = chunk_fns[length] = make_pp_device_train_step(
                 model, opt, mesh, FLAGS.batch_size, micro,
                 keep_prob=FLAGS.keep_prob, chunk=length,
-                grad_transform=clip)
+                grad_transform=clip, virtual_stages=vstages)
         return fn(pp_state, data)
 
     sv = Supervisor(
@@ -1160,7 +1179,7 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
     with sv.managed(state) as box:
         step = box.step
         periodic_eval.prime(step)
-        pp_state = shard_state_pp(box.state, mesh)
+        pp_state = shard_state_pp(box.state, mesh, virtual_stages=vstages)
         host = box.state
         compile_done = False
         meter.reset()
@@ -1190,7 +1209,8 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                         or sv.checkpointer.cadence_due()
                         or step >= FLAGS.training_iter)
             if boundary:
-                host = fetch_state_pp(pp_state, model)
+                host = fetch_state_pp(pp_state, model, k_stages=k_stages,
+                                      virtual_stages=vstages)
                 box.update(host, step)
                 if step % FLAGS.display_step == 0:
                     last_display = {k: float(v) for k, v in m.items()}
@@ -1201,7 +1221,8 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                 periodic_eval(host, step)
                 sv.maybe_checkpoint(host, step)
         jax.block_until_ready(pp_state.params)
-        host = fetch_state_pp(pp_state, model)
+        host = fetch_state_pp(pp_state, model, k_stages=k_stages,
+                              virtual_stages=vstages)
         box.update(host, step)
 
     test_metrics = _final_test_eval(FLAGS, sv, periodic_eval, model, host,

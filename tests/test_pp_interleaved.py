@@ -1,0 +1,379 @@
+"""Interleaved virtual-stage pipeline schedule (--virtual_stages:
+parallel/pp_schedule.py + the schedule-table tick loop in
+parallel/pipeline_parallel.py). Pins:
+
+- the schedule table's structural invariants (bijection, one-tick
+  dataflow dependency, GPipe as the exact V=1 special case) and the
+  masked-FLOP cost model (scheduled block computations strictly DROP
+  vs the GPipe baseline — the whole point of the change);
+- EXACT trajectories: V=2 training bit-matches V=1 on the 8-device
+  mesh, --clip_norm set and dropout on (same PRNG folds, same
+  masked-mean loss, canonical-order clip norm) — host-fed and
+  device-resident chunked steps both;
+- checkpoint layout-independence: save under V=2 -> restore under V=1
+  (and the reverse) continues the bit-exact trajectory, and mid-chunk
+  resume under --pipeline --device_data --virtual_stages=2 matches the
+  uninterrupted run bit-for-bit;
+- parse-time flag validation (the in-step ValueError moved to the
+  command line)."""
+
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.lm import LMDataSet
+from distributed_tensorflow_tpu.models.transformer import TransformerLM
+from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
+from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+    fetch_state_pp,
+    make_pp_train_step,
+    pp_clip_transform,
+    shard_state_pp,
+    stack_block_params,
+    stage_batch_pp,
+    unstack_block_params,
+)
+from distributed_tensorflow_tpu.parallel.pp_schedule import (
+    block_permutation,
+    build_pp_schedule,
+    validate_pp_layout,
+)
+from distributed_tensorflow_tpu.training import (
+    create_train_state,
+    get_optimizer,
+    make_train_step,
+)
+from distributed_tensorflow_tpu.training.train_state import (
+    clip_by_global_norm,
+)
+
+KW8 = dict(vocab_size=16, seq_len=32, d_model=32, num_heads=2,
+           num_blocks=8)
+
+
+# ------------------------------------------------------ schedule table
+
+
+@pytest.mark.parametrize("k,m,v", [(2, 4, 1), (4, 4, 2), (2, 8, 2),
+                                   (2, 6, 3), (4, 8, 2)])
+def test_schedule_table_invariants(k, m, v):
+    """Every (microbatch, virtual-stage) work unit runs exactly once on
+    its device, consecutive virtual stages run exactly one tick apart
+    on consecutive ring neighbors (so ONE carried activation slot
+    suffices), and the tick count / useful fraction match the analytic
+    formulas."""
+    sched = build_pp_schedule(k, m, v)
+    assert sched.num_ticks == m * v + k - 1
+    assert sched.useful_tick_fraction == m * v / (m * v + k - 1)
+    # tick of unit (microbatch mb, virtual stage j): on device j % k
+    tick_of = {}
+    for s in range(k):
+        assert int(sched.valid[:, s].sum()) == m * v
+        seen = set()
+        for t in range(sched.num_ticks):
+            if sched.valid[t, s]:
+                unit = (int(sched.micro_index[t, s]),
+                        int(sched.chunk_index[t, s]))
+                assert unit not in seen  # each unit exactly once
+                seen.add(unit)
+                tick_of[(unit[0], unit[1] * k + s)] = t
+        assert seen == {(mb, vg) for mb in range(m) for vg in range(v)}
+    for mb in range(m):
+        for j in range(v * k - 1):
+            assert tick_of[(mb, j + 1)] == tick_of[(mb, j)] + 1
+
+
+def test_gpipe_is_the_v1_special_case():
+    sched = build_pp_schedule(4, 6, 1)
+    assert sched.num_ticks == 6 + 4 - 1
+    assert (sched.chunk_index == 0).all()
+    for s in range(4):
+        for t in range(sched.num_ticks):
+            if sched.valid[t, s]:
+                assert int(sched.micro_index[t, s]) == t - s
+
+
+def test_scheduled_block_computations_strictly_drop():
+    """The acceptance pin: at K=2, M=8, V=2 the per-step scheduled
+    block executions (masked ticks included — they cost full FLOPs)
+    strictly drop vs the GPipe baseline."""
+    gpipe = build_pp_schedule(2, 8, 1).scheduled_block_computations(8)
+    inter = build_pp_schedule(2, 8, 2).scheduled_block_computations(8)
+    assert inter < gpipe
+    assert gpipe == 9 * 2 * 4   # (M+K-1) ticks x K stages x L blocks
+    assert inter == 17 * 2 * 2  # (MV+K-1) ticks x K stages x L/V blocks
+
+
+def test_schedule_validation_errors():
+    with pytest.raises(ValueError, match="rounds"):
+        build_pp_schedule(2, 3, 2)  # M % K != 0 under interleaving
+    with pytest.raises(ValueError, match="pipeline stages"):
+        validate_pp_layout(6, 2, 2)  # 6 blocks can't form 4 groups
+    with pytest.raises(ValueError, match=">= 1"):
+        validate_pp_layout(8, 2, 0)
+
+
+def test_block_permutation_and_stack_roundtrip():
+    """Round-robin stacking: device s's positions hold virtual stages
+    s, s+K, ... — and unstacking restores the standard list order
+    exactly (the checkpoint-layout contract)."""
+    perm = block_permutation(8, 2, 2)
+    np.testing.assert_array_equal(perm, [0, 1, 4, 5, 2, 3, 6, 7])
+    np.testing.assert_array_equal(block_permutation(8, 2, 1),
+                                  np.arange(8))
+    model = TransformerLM(**KW8)
+    params = model.init(jax.random.PRNGKey(0))
+    back = unstack_block_params(stack_block_params(params, perm), 8, perm)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------- exact-trajectory equality
+
+
+def _run_pp(model, opt, base, mesh, batches, v, microbatches=4,
+            keep_prob=0.5, clip=0.05):
+    st = shard_state_pp(base, mesh, virtual_stages=v)
+    step = make_pp_train_step(
+        model, opt, mesh, microbatches=microbatches, keep_prob=keep_prob,
+        donate=False,
+        grad_transform=pp_clip_transform(clip, virtual_stages=v),
+        virtual_stages=v)
+    for b in batches:
+        st, m = step(st, stage_batch_pp(mesh, b))
+    return fetch_state_pp(st, model, k_stages=mesh.shape["model"],
+                          virtual_stages=v), m
+
+
+def test_v2_trajectory_bitmatches_v1_with_clip():
+    """THE acceptance test: V=2 training bit-matches V=1 for a
+    TransformerLM on the 8-device mesh (data=2, model=4), --clip_norm
+    set and dropout ON — and both match the single-device clipped step
+    to float tolerance. Same blocks applied to the same microbatches in
+    the same order, canonical-order clip norm: nothing may wobble."""
+    model = TransformerLM(**KW8)
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(model, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=11)
+    batches = [ds.next_batch(16) for _ in range(3)]
+
+    host1, m1 = _run_pp(model, opt, base, mesh, batches, v=1)
+    host2, m2 = _run_pp(model, opt, base, mesh, batches, v=2)
+    assert float(m1["loss"]) == float(m2["loss"])
+    assert float(m1["accuracy"]) == float(m2["accuracy"])
+    for a, b in zip(jax.tree.leaves(host1.params),
+                    jax.tree.leaves(host2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # and the pipeline still computes the single-device function
+    # (keep_prob=1.0: the single step's dropout keys differ by design)
+    b1, _ = _run_pp(model, opt, base, mesh, batches, v=1, keep_prob=1.0)
+    b2, _ = _run_pp(model, opt, base, mesh, batches, v=2, keep_prob=1.0)
+    single = create_train_state(model, opt, seed=0)
+    step1 = make_train_step(model, opt, keep_prob=1.0, donate=False,
+                            grad_transform=clip_by_global_norm(0.05))
+    for b in batches:
+        single, ms = step1(single, b)
+    for got, _ in ((b1, 1), (b2, 2)):
+        for a, c in zip(jax.tree.leaves(single.params),
+                        jax.tree.leaves(got.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=2e-4, atol=2e-5)
+
+
+def test_v2_device_chunked_bitmatches_v1():
+    """The device-resident chunked sampler under V=2 == V=1 bitwise:
+    the DATA-axis-only sample fold is layout-independent, so the same
+    rows are drawn and the schedule equivalence carries through the
+    scan-chunked composition (clip on)."""
+    from distributed_tensorflow_tpu.data.device_data import (
+        put_device_data,
+    )
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_pp_device_train_step,
+    )
+
+    model = TransformerLM(**KW8)
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(model, opt, seed=0)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=3)
+    data = put_device_data(ds, mesh, data_sharded=True)
+    outs = {}
+    for v in (1, 2):
+        dev = shard_state_pp(base, mesh, virtual_stages=v)
+        dstep = make_pp_device_train_step(
+            model, opt, mesh, 8, 4, keep_prob=1.0, chunk=2, donate=False,
+            grad_transform=pp_clip_transform(0.05, virtual_stages=v),
+            virtual_stages=v)
+        dev, m = dstep(dev, data)
+        outs[v] = (fetch_state_pp(dev, model, k_stages=4,
+                                  virtual_stages=v), float(m["loss"]))
+    assert outs[1][1] == outs[2][1]
+    for a, b in zip(jax.tree.leaves(outs[1][0].params),
+                    jax.tree.leaves(outs[2][0].params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------- checkpoint layout independence
+
+
+def test_checkpoint_roundtrip_across_layouts(tmp_path):
+    """Save under V=2 -> restore under V=1 (and the reverse) continues
+    the exact trajectory: checkpoints are layout-independent because
+    fetch_state_pp always emits the STANDARD block-list order."""
+    from distributed_tensorflow_tpu.checkpoint import (
+        restore_latest,
+        save_checkpoint,
+    )
+
+    model = TransformerLM(**KW8)
+    opt = get_optimizer("sgd", 0.05)
+    base = create_train_state(model, opt, seed=3)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    ds = LMDataSet(64, seq_len=32, vocab_size=16, seed=1)
+    batches = [ds.next_batch(16) for _ in range(2)]
+
+    # uninterrupted two-step reference (V=2 == V=1 by the test above)
+    ref, _ = _run_pp(model, opt, base, mesh, batches, v=2,
+                     keep_prob=1.0)
+
+    for v_save, v_resume in ((2, 1), (1, 2)):
+        mid, _ = _run_pp(model, opt, base, mesh, batches[:1], v=v_save,
+                         keep_prob=1.0)
+        d = tmp_path / f"ckpt_{v_save}to{v_resume}"
+        save_checkpoint(str(d), mid, step=1)
+        restored, step = restore_latest(
+            str(d), create_train_state(model, opt, seed=9))
+        assert step == 1
+        done, _ = _run_pp(model, opt, restored, mesh, batches[1:],
+                          v=v_resume, keep_prob=1.0)
+        for a, b in zip(jax.tree.leaves(ref.params),
+                        jax.tree.leaves(done.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _parse(flags, args):
+    flags.FLAGS._reset()
+    flags.FLAGS._parse(args)
+    return flags.FLAGS
+
+
+def test_device_pp_interleaved_mid_chunk_resume(tmp_path):
+    """--pipeline --device_data --virtual_stages=2 through the
+    production CLI: stop at a step that is NOT a chunk boundary, resume
+    from the standard-layout checkpoint, and land on bit-identical
+    params vs the uninterrupted run (the resumed loop realigns with a
+    short chunk; state determinism must survive the different chunk
+    partitioning and the stack/unstack round-trip)."""
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.checkpoint import restore_latest
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+
+    def args_for(logdir, iters):
+        return [f"--logdir={logdir}", f"--data_dir={tmp_path}/none",
+                "--dataset=lm", "--model=lm", "--pipeline",
+                "--model_axis=2", "--virtual_stages=2", "--num_blocks=4",
+                "--d_model=32", "--num_heads=2", "--seq_len=32",
+                "--vocab_size=16", "--batch_size=16",
+                f"--training_iter={iters}", "--display_step=3",
+                "--device_data", "--device_chunk=3", "--clip_norm=0.5",
+                "--test_eval=false"]
+
+    try:
+        # interrupted: 5 steps (chunk lengths 3 + 2), then resume to 9
+        res = train(_parse(flags, args_for(f"{tmp_path}/a", 5)),
+                    mode="sync")
+        assert res.final_step == 5
+        res = train(_parse(flags, args_for(f"{tmp_path}/a", 9)),
+                    mode="sync")
+        assert res.final_step == 9
+        # uninterrupted: straight to 9 (chunks 3 + 3 + 3)
+        res_b = train(_parse(flags, args_for(f"{tmp_path}/b", 9)),
+                      mode="sync")
+        assert res_b.final_step == 9
+    finally:
+        flags.FLAGS._reset()
+
+    model = TransformerLM(vocab_size=16, seq_len=32, d_model=32,
+                          num_heads=2, num_blocks=4)
+    opt = get_optimizer("sgd", 0.001)
+    tmpl = lambda: create_train_state(model, opt, seed=9)
+    got_a, step_a = restore_latest(f"{tmp_path}/a", tmpl())
+    got_b, step_b = restore_latest(f"{tmp_path}/b", tmpl())
+    assert step_a == step_b == 9
+    for a, b in zip(jax.tree.leaves(got_a.params),
+                    jax.tree.leaves(got_b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------ parse-time validation
+
+
+def test_virtual_stages_flag_validation():
+    from distributed_tensorflow_tpu import flags
+
+    flags.define_reference_flags()
+    cases = [
+        (["--virtual_stages=2"], "only applies to --pipeline"),
+        (["--pipeline", "--model_axis=2", "--num_blocks=4",
+          "--virtual_stages=4"], "block groups"),
+        (["--pipeline", "--model_axis=2", "--num_blocks=4",
+          "--batch_size=12", "--pp_microbatches=3",
+          "--virtual_stages=2"], "rounds of the stage count"),
+        (["--pipeline", "--batch_size=10", "--pp_microbatches=4"],
+         "must split into"),
+        (["--virtual_stages=0", "--pipeline"], "must be >= 1"),
+    ]
+    try:
+        for args, want in cases:
+            flags.FLAGS._reset()
+            with pytest.raises(ValueError, match=want):
+                flags.FLAGS._parse(args)
+        # the valid interleaved config parses clean, V defaults to 1
+        flags.FLAGS._reset()
+        flags.FLAGS._parse(["--pipeline", "--model_axis=2",
+                            "--num_blocks=8", "--virtual_stages=2",
+                            "--batch_size=16"])
+        assert flags.FLAGS.virtual_stages == 2
+        flags.FLAGS._reset()
+        flags.FLAGS._parse([])
+        assert flags.FLAGS.virtual_stages == 1
+    finally:
+        flags.FLAGS._reset()
+
+
+def test_fetch_state_pp_requires_k_for_interleaved():
+    model = TransformerLM(**KW8)
+    opt = get_optimizer("sgd", 0.05)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    st = shard_state_pp(create_train_state(model, opt, seed=0), mesh,
+                        virtual_stages=2)
+    with pytest.raises(ValueError, match="k_stages"):
+        fetch_state_pp(st, model, virtual_stages=2)
+
+
+# ------------------------------------------------------- tooling
+
+
+def test_trace_ops_schedule_mode(tmp_path):
+    """tools/trace_ops.py --schedule prints the static tick table and
+    the analytic useful-tick fraction without needing a chip or a
+    trace file."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "trace_ops.py"),
+         "--schedule", "2", "8", "2"],
+        capture_output=True, text=True, timeout=300, cwd=root)
+    assert p.returncode == 0, p.stderr
+    assert "K=2 stages, M=8 microbatches, V=2" in p.stdout
+    assert f"{16 / 17:.4f}" in p.stdout  # M*V/(M*V+K-1)
+    assert "m7.v1" in p.stdout  # the last work unit appears in the table

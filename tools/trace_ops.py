@@ -1,4 +1,5 @@
-"""Aggregate per-op device time from a jax.profiler trace.
+"""Aggregate per-op device time from a jax.profiler trace, and print
+static pipeline schedules.
 
 The only reliable per-op instrument on tunneled chips (PERF.md): the
 trace's device "XLA Ops" lane durations sum to the wall, per-op, where
@@ -6,7 +7,15 @@ RPC-latency-polluted microbenchmarks are ~10x wrong. Loads the newest
 ``*.trace.json.gz`` under a profile dir, selects the XLA Ops thread,
 and prints a table: op name, calls, total ms, share, bytes accessed.
 
+``--schedule K M [V]`` instead prints the static pipeline tick table
+the --pipeline step compiles for K stages x M microbatches x V virtual
+stage groups (parallel/pp_schedule.py — GPipe when V=1, interleaved
+when V>1), with the per-stage useful-tick fraction and total scheduled
+block-group computations: the masked-tick cost model at a glance, no
+chip required.
+
 Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
+       python tools/trace_ops.py --schedule K M [V]
 """
 
 from __future__ import annotations
@@ -63,6 +72,27 @@ def aggregate(events: list[dict]) -> list[dict]:
     return rows
 
 
+def print_schedule(k_stages: int, microbatches: int,
+                   virtual_stages: int = 1) -> None:
+    """Print the static (K, M, V) pipeline tick table + schedule cost
+    facts — the same builder the compiled step closes over, so what
+    prints here IS what runs."""
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from distributed_tensorflow_tpu.parallel.pp_schedule import (
+        build_pp_schedule,
+        format_schedule,
+    )
+
+    sched = build_pp_schedule(k_stages, microbatches, virtual_stages)
+    print(format_schedule(sched))
+    per_group = f"num_blocks/{k_stages * virtual_stages}"
+    print(f"\nscheduled block-group computations per step: "
+          f"{sched.num_ticks * k_stages} x ({per_group} blocks each)")
+
+
 def main(profile_dir: str, top_n: int = 25) -> None:
     rows = aggregate(xla_op_events(load_trace(profile_dir)))
     total_us = sum(r["us"] for r in rows)
@@ -81,4 +111,9 @@ def main(profile_dir: str, top_n: int = 25) -> None:
 
 
 if __name__ == "__main__":
-    main(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 25)
+    if sys.argv[1] == "--schedule":
+        k, m = int(sys.argv[2]), int(sys.argv[3])
+        v = int(sys.argv[4]) if len(sys.argv) > 4 else 1
+        print_schedule(k, m, v)
+    else:
+        main(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 25)
