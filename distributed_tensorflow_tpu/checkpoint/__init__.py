@@ -1,21 +1,29 @@
 from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     Checkpointer,
+    CheckpointCorruptError,
+    RestoreReport,
     background_save_from_flags,
     max_to_keep_from_flags,
     save_checkpoint,
     save_checkpoint_sharded,
     load_flat_sharded,
     restore_latest,
+    restore_with_fallback,
     latest_checkpoint,
+    quarantine_step,
 )
 
 __all__ = [
     "Checkpointer",
+    "CheckpointCorruptError",
+    "RestoreReport",
     "background_save_from_flags",
     "max_to_keep_from_flags",
     "save_checkpoint",
     "save_checkpoint_sharded",
     "load_flat_sharded",
     "restore_latest",
+    "restore_with_fallback",
     "latest_checkpoint",
+    "quarantine_step",
 ]

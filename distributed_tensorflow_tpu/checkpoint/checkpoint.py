@@ -22,9 +22,13 @@ import re
 import tempfile
 import threading
 import time
+import zipfile
+from dataclasses import dataclass
 
 import numpy as np
 
+from distributed_tensorflow_tpu.utils.events import crc32c
+from distributed_tensorflow_tpu.utils.faults import fault_point
 from distributed_tensorflow_tpu.utils.pytree import (
     _BF16_TAG,
     flatten_pytree,
@@ -33,6 +37,27 @@ from distributed_tensorflow_tpu.utils.pytree import (
 
 _INDEX = "checkpoint"  # index filename, same as TF's
 _PREFIX = "ckpt"
+# per-array CRC-32C manifest stamped into every save (monolithic: its own
+# npz entry; sharded: a field of __shardmeta__). Restore verifies it, so a
+# bit-rotted or partially-written array fails LOUDLY at decode instead of
+# training on garbage — and the restore ladder (restore_with_fallback) can
+# quarantine the set and walk back. Manifest-less files (older saves)
+# still restore, unverified.
+_MANIFEST = "__manifest__"
+_MANIFEST_VERSION = 1
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint set that is structurally present but fails integrity
+    verification: CRC mismatch, torn shard meta, overlapping or gapped
+    slice coverage (a mixed save-attempt set). ``restore_with_fallback``
+    quarantines the set and falls back; every other reader stays loud."""
+
+
+class CheckpointFormatError(ValueError):
+    """An INTACT checkpoint this build cannot read (format version from a
+    newer build). Deliberately not a corruption: the fallback ladder must
+    stay loud rather than quarantine a perfectly good file."""
 # optional 8-hex attempt nonce before .npz: shard sets from two save
 # ATTEMPTS at the same (step, n) — a crashed save at step S, then a
 # restart that re-reaches S with the same process count — must never
@@ -67,18 +92,75 @@ def _default_attempt_token() -> str:
     return secrets.token_hex(4) if jax.process_count() == 1 else ""
 
 
+def _fsync_dir(directory: str) -> None:
+    """fsync the directory entry so a rename survives a machine crash
+    (file fsync alone leaves the dirent unjournaled on many filesystems).
+    Best-effort: platforms that can't open a directory skip it."""
+    try:
+        dfd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
+
+
 def _atomic_npz(directory: str, final: str, arrays: dict) -> None:
-    """tmp + rename so a killed process never leaves a torn file — the
-    one implementation under both checkpoint formats."""
+    """tmp + fsync + rename + dir-fsync so neither a killed process nor a
+    machine crash can leave a torn or zero-length "complete" file — the
+    one implementation under both checkpoint formats. (Without the
+    fsyncs, a crash after the rename could journal the dirent before the
+    data, surfacing a zero-length npz the restore verifier would then
+    have to quarantine.)"""
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, final)
+        _fsync_dir(directory)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+
+
+def _manifest_entry(flat: dict[str, np.ndarray]) -> np.ndarray:
+    """The JSON manifest stored alongside the arrays: per-key CRC-32C of
+    the raw array bytes (utils/events.crc32c — the bulk-speed twin of the
+    event writer's checksum)."""
+    crcs = {k: crc32c(np.ascontiguousarray(v)) for k, v in flat.items()}
+    blob = json.dumps({"version": _MANIFEST_VERSION, "crc32c": crcs})
+    return np.frombuffer(blob.encode(), dtype=np.uint8)
+
+
+def _verify_flat(path: str, flat: dict[str, np.ndarray],
+                 manifest: dict | None) -> None:
+    """CRC-check ``flat`` against a parsed manifest; None (a pre-manifest
+    checkpoint) verifies nothing — old files keep restoring."""
+    if manifest is None:
+        return
+    crcs = manifest.get("crc32c", {})
+    missing = set(crcs) - set(flat)
+    if missing:
+        raise CheckpointCorruptError(
+            f"{path}: manifest lists {sorted(missing)} but the arrays are "
+            f"absent — file truncated or mixed")
+    for k, v in flat.items():
+        want = crcs.get(k)
+        if want is None:
+            raise CheckpointCorruptError(
+                f"{path}: array {k!r} is not covered by the manifest")
+        got = crc32c(np.ascontiguousarray(v))
+        if got != want:
+            raise CheckpointCorruptError(
+                f"{path}: CRC-32C mismatch for {k!r} "
+                f"(stored {want:#010x}, computed {got:#010x}) — bit rot "
+                f"or a torn write")
 
 
 def save_checkpoint(directory: str, state, step: int, max_to_keep: int = 5) -> str:
@@ -94,7 +176,8 @@ def _write_flat(directory: str, flat: dict[str, np.ndarray], step: int,
     on a background thread)."""
     os.makedirs(directory, exist_ok=True)
     final = os.path.join(directory, f"{_PREFIX}-{step}.npz")
-    _atomic_npz(directory, final, flat)
+    _atomic_npz(directory, final, {**flat, _MANIFEST: _manifest_entry(flat)})
+    fault_point("ckpt_write", path=final, step=step)
     _write_index(directory, step)
     _gc(directory, max_to_keep)
     return final
@@ -182,13 +265,16 @@ def save_checkpoint_sharded(directory: str, state, step: int,
                 {"npz": npz_key, "index": spec, "bf16": bool(bf16)})
 
     meta = {"version": _SHARD_FORMAT_VERSION, "process": p, "n_shards": n,
-            "step": step, "attempt": attempt, "leaves": leaves_meta}
+            "step": step, "attempt": attempt, "leaves": leaves_meta,
+            "crc32c": {k: crc32c(np.ascontiguousarray(v))
+                       for k, v in arrays.items()}}
     arrays[_SHARDMETA] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
     suffix = f".{attempt}" if attempt else ""
     final = os.path.join(directory,
                          f"{_PREFIX}-{step}.shard{p}-of-{n}{suffix}.npz")
     _atomic_npz(directory, final, arrays)
+    fault_point("ckpt_write", path=final, step=step)
     if p == 0:
         _write_index(directory, step)
     _gc(directory, max_to_keep)
@@ -252,13 +338,34 @@ def load_flat_sharded(directory: str, step: int) -> dict[str, np.ndarray]:
             f"{directory!r}")
     parts: dict[str, dict] = {}
     for path in paths:
+        fault_point("restore", path=path, step=step)
         with np.load(path) as z:
-            meta = json.loads(bytes(z[_SHARDMETA]).decode())
+            try:
+                meta = json.loads(bytes(z[_SHARDMETA]).decode())
+            except KeyError:
+                raise CheckpointCorruptError(
+                    f"{path}: no {_SHARDMETA} entry — not a shard file "
+                    f"this build wrote, or torn") from None
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                raise CheckpointCorruptError(
+                    f"{path}: shard meta does not decode ({e})") from None
             if meta.get("version") != _SHARD_FORMAT_VERSION:
-                raise ValueError(
+                raise CheckpointFormatError(
                     f"{path}: sharded-checkpoint format version "
                     f"{meta.get('version')} (this build reads "
                     f"{_SHARD_FORMAT_VERSION})")
+            crcs = meta.get("crc32c")  # absent on pre-manifest saves
+            if crcs is not None:
+                for k, want in crcs.items():
+                    if k not in z.files:
+                        raise CheckpointCorruptError(
+                            f"{path}: manifest lists {k!r} but the array "
+                            f"is absent")
+                    got = crc32c(np.ascontiguousarray(z[k]))
+                    if got != want:
+                        raise CheckpointCorruptError(
+                            f"{path}: CRC-32C mismatch for {k!r} (stored "
+                            f"{want:#010x}, computed {got:#010x})")
             for key, info in meta["leaves"].items():
                 dst = parts.setdefault(key, {
                     "global_shape": tuple(info["global_shape"]),
@@ -282,14 +389,14 @@ def load_flat_sharded(directory: str, step: int) -> dict[str, np.ndarray]:
         for spec, data, _ in entries:
             sl = tuple(slice(s, e) for s, e in spec)
             if mask[sl].any():
-                raise ValueError(
+                raise CheckpointCorruptError(
                     f"sharded checkpoint step {step}: leaf {key!r} has "
                     f"overlapping entries at {spec} — set mixes save "
                     f"attempts")
             out[sl] = data
             mask[sl] = True
         if not mask.all():
-            raise ValueError(
+            raise CheckpointCorruptError(
                 f"sharded checkpoint step {step}: leaf {key!r} covers "
                 f"{int(mask.sum())} of {out.size} elements — set "
                 f"incomplete")
@@ -298,10 +405,14 @@ def load_flat_sharded(directory: str, step: int) -> dict[str, np.ndarray]:
 
 
 def _write_index(directory: str, step: int):
+    fault_point("ckpt_index", step=step)
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     with os.fdopen(fd, "w") as f:
         json.dump({"latest_step": step, "time": time.time()}, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, os.path.join(directory, _INDEX))
+    _fsync_dir(directory)
 
 
 def _all_steps(directory: str) -> list[int]:
@@ -326,7 +437,11 @@ def _gc(directory: str, max_to_keep: int):
     cadence means nobody is still writing those. One directory scan."""
     # (stale-ATTEMPT files at a step still inside the retention window
     # survive until the step leaves it — bounded by max_to_keep sets and
-    # never restorable, since completeness requires a matching nonce)
+    # never restorable, since completeness requires a matching nonce.
+    # Quarantined *.corrupt files are invisible to every scan here: they
+    # neither count toward max_to_keep nor get deleted — kept for
+    # postmortem until an operator removes them.)
+    fault_point("ckpt_gc")
     complete, all_shards = _scan_shards(directory)
     mono = set()
     for name in os.listdir(directory):
@@ -375,22 +490,18 @@ def _step_available(directory: str, step: int) -> str | None:
 def latest_checkpoint(directory: str) -> tuple[str, int] | None:
     """(path, step) of the newest complete checkpoint, or None. For a
     sharded set the path is its shard-0 file — load through
-    ``load_flat`` (which dispatches on the name), not a bare np.load."""
+    ``load_flat`` (which dispatches on the name), not a bare np.load.
+
+    Selection is a DIRECTORY SCAN, newest restorable step first. The
+    index file is still written (TF parity; external tooling reads it)
+    but is NOT trusted for selection: a crash between a checkpoint file
+    landing and the index write (exactly what ``ckpt_write:mode=crash``
+    injects) would otherwise hide the newer complete checkpoint behind a
+    stale index — r8. Availability is re-checked per step because a
+    peer's concurrent GC can delete a step between the listing and the
+    pick; quarantined ``*.corrupt`` files never match the scan."""
     if not os.path.isdir(directory):
         return None
-    idx = os.path.join(directory, _INDEX)
-    if os.path.exists(idx):
-        try:
-            with open(idx) as f:
-                step = json.load(f)["latest_step"]
-            p = _step_available(directory, step)
-            if p is not None:
-                return p, step
-        except (json.JSONDecodeError, KeyError, OSError):
-            pass
-    # index torn/missing: fall back to files, newest first. Re-check
-    # availability per step — a peer's concurrent GC can delete a step
-    # between the listing and the pick
     for step in reversed(_all_steps(directory)):
         p = _step_available(directory, step)
         if p is not None:
@@ -400,13 +511,29 @@ def latest_checkpoint(directory: str) -> tuple[str, int] | None:
 
 def load_flat(path: str) -> dict[str, np.ndarray]:
     """Flat path-keyed arrays from EITHER format: a monolithic npz, or
-    any shard file of a complete sharded set (reassembled)."""
+    any shard file of a complete sharded set (reassembled). Verifies the
+    per-array CRC-32C manifest when one is present (saves from this build
+    stamp one; older files load unverified) — a failed check raises
+    CheckpointCorruptError instead of returning silently-wrong tensors."""
     m = _SHARD_RE.fullmatch(os.path.basename(path))
     if m:
         return load_flat_sharded(os.path.dirname(path) or ".",
                                  int(m.group(1)))
+    sm = re.fullmatch(rf"{_PREFIX}-(\d+)\.npz", os.path.basename(path))
+    fault_point("restore", path=path,
+                step=int(sm.group(1)) if sm else None)
     with np.load(path) as z:
-        return {k: z[k] for k in z.files}
+        flat = {k: z[k] for k in z.files}
+    manifest = None
+    raw = flat.pop(_MANIFEST, None)
+    if raw is not None:
+        try:
+            manifest = json.loads(bytes(raw).decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptError(
+                f"{path}: manifest does not decode ({e})") from None
+    _verify_flat(path, flat, manifest)
+    return flat
 
 
 def checkpoint_keys(path: str) -> set[str]:
@@ -416,7 +543,7 @@ def checkpoint_keys(path: str) -> set[str]:
     m = _SHARD_RE.fullmatch(os.path.basename(path))
     if not m:
         with np.load(path) as z:
-            return set(z.files)
+            return set(z.files) - {_MANIFEST}
     keys: set[str] = set()
     directory = os.path.dirname(path) or "."
     shards = _sharded_steps(directory).get(int(m.group(1)))
@@ -440,7 +567,10 @@ def restore_latest(directory: str, template):
     """Restore the newest checkpoint into the structure of ``template``;
     returns (state, step) or None if no checkpoint exists — the
     init-or-restore decision the Supervisor makes (MNISTDist.py:169-170).
-    Reads both the monolithic and the sharded format."""
+    Reads both the monolithic and the sharded format; a newest set that
+    fails verification raises loudly (the Supervisor's path uses
+    ``restore_with_fallback`` instead, which quarantines and walks
+    back)."""
     found = latest_checkpoint(directory)
     if found is None:
         return None
@@ -450,6 +580,150 @@ def restore_latest(directory: str, template):
         return unflatten_pytree(template, flat), step
     except KeyError as e:
         raise KeyError(f"checkpoint {path}: {e}") from None
+
+
+# ------------------------------------ verified restore / fallback ladder
+
+
+@dataclass
+class RestoreReport:
+    """Recovery observability for one restore: where the state actually
+    came from and what it cost to get it (training/loop emits these as
+    ``recovery_*`` scalars; bench.py records them)."""
+
+    step: int | None = None
+    path: str | None = None
+    fallback_depth: int = 0  # older sets walked to (quarantines + rescans)
+    quarantined: tuple[str, ...] = ()
+    rescans: int = 0
+    time_s: float = 0.0
+
+
+def _is_corrupt_error(e: BaseException) -> bool:
+    """Errors raised WHILE DECODING a checkpoint file that mean THIS SET
+    is damaged (quarantine and fall back): our own verification raises,
+    zip-level truncation, and any decode-layer ValueError — numpy raises
+    a bare ValueError for a rotted .npy member header ('magic string is
+    not correct'), which is as much bit rot as a CRC mismatch. Never
+    FileNotFoundError (racing peer GC: re-scan, no quarantine) and never
+    CheckpointFormatError (an intact file from a newer build: loud).
+    Template mismatches can't reach this classifier — the ladder applies
+    it only to the file-decode phase, and unflatten runs after."""
+    if isinstance(e, (FileNotFoundError, CheckpointFormatError)):
+        return False
+    return isinstance(e, (CheckpointCorruptError, zipfile.BadZipFile,
+                          EOFError, ValueError))
+
+
+def _quarantine_paths(paths: list[str]) -> list[str]:
+    """Rename each file to ``*.corrupt`` (suffix-numbered on collision).
+    Quarantined names no longer fullmatch any scan regex, so they are
+    invisible to ``latest_checkpoint`` and to GC accounting — excluded
+    from max_to_keep, never deleted, kept for postmortem."""
+    moved = []
+    for p in paths:
+        dst = p + ".corrupt"
+        i = 1
+        while os.path.exists(dst):
+            dst = f"{p}.corrupt{i}"
+            i += 1
+        try:
+            os.replace(p, dst)
+            moved.append(dst)
+        except OSError:
+            pass  # vanished under us (racing GC) — nothing to quarantine
+    return moved
+
+
+def quarantine_step(directory: str, step: int) -> list[str]:
+    """Quarantine every restorable file representing ``step``: the
+    monolithic npz and/or the complete shard set. Orphan shards of other
+    attempts stay — they were never restorable and remain GC's business.
+    Returns the new (quarantined) paths."""
+    paths = []
+    mono = os.path.join(directory, f"{_PREFIX}-{step}.npz")
+    if os.path.exists(mono):
+        paths.append(mono)
+    paths += _sharded_steps(directory).get(step, [])
+    return _quarantine_paths(paths)
+
+
+def restore_with_fallback(directory: str, template, *,
+                          max_rescans: int = 3):
+    """THE restore ladder: newest checkpoint first, walking back to the
+    newest OLDER complete set whenever the pick turns out damaged.
+
+    Every injected failure mode lands in one of three rungs:
+      - FileNotFoundError mid-read (a racing peer's GC deleted the set
+        between selection and read): re-scan, bounded by ``max_rescans``
+        — a transient of healthy concurrent operation, nothing is
+        quarantined.
+      - corruption (CRC mismatch, torn/zero-length file, undecodable
+        shard meta, mixed-attempt coverage): the whole set is renamed to
+        ``*.corrupt`` (excluded from latest_checkpoint and GC
+        accounting) and the ladder continues one rung down.
+      - structural mismatch (missing key / wrong shape for ``template``):
+        LOUD, immediately — falling back would silently resurrect an old
+        trajectory under a changed config.
+
+    Returns ``(state, step, RestoreReport)``, or None when the directory
+    holds no checkpoint at all. Raises CheckpointCorruptError when sets
+    existed but every one was quarantined — the ladder exhausting is the
+    one failure that must never look like a fresh init."""
+    t0 = time.monotonic()
+    depth = 0
+    rescans = 0
+    quarantined: list[str] = []
+    while True:
+        found = latest_checkpoint(directory)
+        if found is None:
+            if quarantined:
+                raise CheckpointCorruptError(
+                    f"no restorable checkpoint left in {directory!r}: "
+                    f"every set failed verification; quarantined "
+                    f"{quarantined}")
+            return None
+        path, step = found
+        try:
+            flat = load_flat(path)
+        except FileNotFoundError as e:
+            rescans += 1
+            if rescans > max_rescans:
+                raise
+            print(f"checkpoint vanished mid-restore (racing peer GC?): "
+                  f"{e} — re-scanning for an older complete checkpoint "
+                  f"(attempt {rescans}/{max_rescans})")
+            depth += 1
+            continue
+        except Exception as e:  # noqa: BLE001 — decode-phase, classified
+            if not _is_corrupt_error(e):
+                raise
+            moved = quarantine_step(directory, step)
+            quarantined += moved
+            depth += 1
+            print(f"checkpoint at step {step} failed verification "
+                  f"({type(e).__name__}: {e}); quarantined {len(moved)} "
+                  f"file(s) to *.corrupt — falling back to the "
+                  f"next-older complete checkpoint")
+            if not moved and _step_available(directory, step) is not None:
+                # the files are still there and could not be renamed
+                # (permissions?): re-looping would spin on this step
+                raise
+            # moved, or a PEER's quarantine/GC beat ours to the rename
+            # (shared logdir): either way the next scan cannot pick this
+            # set again — fall back, don't die while the peer survives
+            continue
+        # template phase — OUTSIDE the corruption classifier: a missing
+        # key (KeyError) or shape mismatch (ValueError) is a structural
+        # mismatch with an INTACT file and must stay loud
+        try:
+            state = unflatten_pytree(template, flat)
+        except KeyError as e:
+            raise KeyError(f"checkpoint {path}: {e}") from None
+        return state, step, RestoreReport(
+            step=step, path=path, fallback_depth=depth,
+            quarantined=tuple(quarantined), rescans=rescans,
+            time_s=time.monotonic() - t0)
 
 
 def background_save_from_flags(FLAGS) -> bool:
@@ -502,6 +776,7 @@ class Checkpointer:
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
         self._closed = False
+        self.last_restore_report: RestoreReport | None = None
 
     def cadence_due(self) -> bool:
         """True when the chief's time-based save cadence has elapsed —
@@ -602,7 +877,17 @@ class Checkpointer:
                 self._thread = None
 
     def restore(self, template):
-        return restore_latest(self.directory, template)
+        """Verified restore through the fallback ladder (quarantine a
+        corrupt newest set, walk back — restore_with_fallback); the
+        RestoreReport lands in ``last_restore_report`` for the
+        Supervisor's recovery observability."""
+        out = restore_with_fallback(self.directory, template)
+        if out is None:
+            self.last_restore_report = None
+            return None
+        state, step, report = out
+        self.last_restore_report = report
+        return state, step
 
     # --- background machinery ---
 

@@ -3,10 +3,14 @@ TF's Saver tooling, for this build's npz pytree checkpoints.
 
     python -m distributed_tensorflow_tpu.checkpoint.inspect --logdir /tmp/train_logs
     python -m distributed_tensorflow_tpu.checkpoint.inspect --path ckpt-1000.npz --key params/weights/wd1
+    python -m distributed_tensorflow_tpu.checkpoint.inspect --verify --logdir /tmp/train_logs
 
 Lists every stored array (path key, shape, dtype — bf16-tagged entries
 decoded), the global step, and the total parameter count; ``--key`` also
-prints one array's statistics. Read-only; works on checkpoints from every
+prints one array's statistics. ``--verify`` checksum-checks EVERY set in
+a logdir (both formats) against the per-array CRC-32C manifests, reports
+ok/CORRUPT/incomplete per step, and exits nonzero if the newest
+restorable set is corrupt. Read-only; works on checkpoints from every
 mode (full TrainState layouts and ps-mode params-only layouts alike).
 """
 
@@ -83,6 +87,79 @@ def describe(path: str, key: str | None = None, out=None) -> int:
     return 0
 
 
+def verify_logdir(directory: str, out=None) -> int:
+    """``--verify``: checksum-check every checkpoint set in ``directory``
+    — monolithic files AND sharded sets — through the same load paths
+    restore uses (manifest CRC-32C + coverage/mixing checks). Prints one
+    line per (step, format): ok / ok (no manifest) / CORRUPT (reason) /
+    incomplete (j/n shards). Returns nonzero iff the NEWEST restorable
+    set — the one restore would pick first — is corrupt."""
+    import os
+
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        _MANIFEST,
+        _PREFIX,
+        _scan_shards,
+        load_flat,
+        load_flat_sharded,
+    )
+
+    out = out if out is not None else sys.stdout
+    if not os.path.isdir(directory):
+        print(f"no such directory: {directory}", file=sys.stderr)
+        return 1
+    complete, all_shards = _scan_shards(directory)
+    mono: dict[int, str] = {}
+    import re
+
+    for name in os.listdir(directory):
+        m = re.fullmatch(rf"{_PREFIX}-(\d+)\.npz", name)
+        if m:
+            mono[int(m.group(1))] = os.path.join(directory, name)
+    quarantined = [n for n in os.listdir(directory) if ".corrupt" in n]
+    steps = sorted(set(mono) | set(complete) | set(all_shards))
+    if not steps:
+        print(f"no checkpoints in {directory}", file=out)
+        return 1
+    restorable = sorted(set(mono) | set(complete))
+    newest = restorable[-1] if restorable else None
+    newest_ok = True
+    for step in steps:
+        if step in mono:
+            try:
+                with np.load(mono[step]) as z:
+                    has_manifest = _MANIFEST in z.files
+                load_flat(mono[step])
+                status = "ok" if has_manifest else "ok (no manifest)"
+            except Exception as e:  # noqa: BLE001 — reported per set
+                status = f"CORRUPT ({type(e).__name__}: {e})"
+                if step == newest:
+                    newest_ok = False
+            print(f"step {step} [monolithic]: {status}", file=out)
+        if step in complete:
+            n = len(complete[step])
+            try:
+                load_flat_sharded(directory, step)
+                status = "ok"
+            except Exception as e:  # noqa: BLE001 — reported per set
+                status = f"CORRUPT ({type(e).__name__}: {e})"
+                if step == newest and step not in mono:
+                    newest_ok = False
+            print(f"step {step} [sharded x{n}]: {status}", file=out)
+        elif step in all_shards and step not in mono:
+            print(f"step {step} [sharded]: incomplete "
+                  f"({len(all_shards[step])} orphan shard file(s), no "
+                  f"complete set)", file=out)
+    if quarantined:
+        print(f"{len(quarantined)} quarantined *.corrupt file(s) present",
+              file=out)
+    if not newest_ok:
+        print(f"newest restorable set (step {newest}) is CORRUPT — "
+              f"restore would quarantine it and fall back", file=out)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="Inspect a distributed_tensorflow_tpu checkpoint")
@@ -90,7 +167,15 @@ def main(argv=None) -> int:
                    "latest checkpoint, like restore does)")
     p.add_argument("--path", help="a specific ckpt-N.npz file")
     p.add_argument("--key", help="also print statistics of this array")
+    p.add_argument("--verify", action="store_true",
+                   help="checksum-check EVERY set in --logdir (both "
+                   "formats); nonzero exit if the newest restorable set "
+                   "is corrupt")
     args = p.parse_args(argv)
+    if args.verify:
+        if not args.logdir:
+            p.error("--verify requires --logdir")
+        return verify_logdir(args.logdir)
     if bool(args.logdir) == bool(args.path):
         p.error("exactly one of --logdir / --path is required")
     path = args.path

@@ -65,22 +65,118 @@ def resolve_mode(FLAGS) -> str:
     return "local"
 
 
-def maybe_initialize_distributed(cluster: ClusterSpec, task_index: int) -> bool:
+def _initialize_with_retry(init_fn, *, retries: int, backoff_s: float,
+                           what: str, sleep=None, cleanup_fn=None) -> None:
+    """Bounded retry/backoff around a cluster-join callable.
+
+    The crash-restart recovery path: a worker relaunched after a crash
+    reaches ``jax.distributed.initialize`` while the coordinator (worker
+    0's host) is itself still coming back — without retry the relaunch
+    dies immediately on connection-refused and the recovery story ends
+    there. Backoff is linear (attempt x ``backoff_s``, capped at 30 s);
+    the final attempt re-raises, so a genuinely dead coordinator still
+    fails loudly after a bounded wait. ``sleep`` is injectable for
+    tests; the ``init`` fault point fires inside the loop, so
+    ``--fault_spec init:mode=refuse:times=2`` proves the retry path
+    deterministically."""
+    import time
+
+    from distributed_tensorflow_tpu.utils.faults import fault_point
+
+    sleep = sleep or time.sleep
+    for attempt in range(retries + 1):
+        try:
+            fault_point("init", attempt=attempt)
+            init_fn()
+            return
+        except (TypeError, ValueError, KeyError, AttributeError,
+                AssertionError):
+            # deterministic misconfiguration (bad address string, API
+            # misuse) — retrying would just serve the same error after
+            # the full backoff schedule; stay loud and fast
+            raise
+        except Exception as e:  # noqa: BLE001 — connection-class errors
+            if attempt >= retries:
+                raise
+            if cleanup_fn is not None:
+                cleanup_fn()
+            delay = min(backoff_s * (attempt + 1), 30.0)
+            print(f"{what} failed (attempt {attempt + 1}/{retries + 1}: "
+                  f"{type(e).__name__}: {e}); coordinator may still be "
+                  f"relaunching — retrying in {delay:.1f}s", flush=True)
+            sleep(delay)
+
+
+def maybe_initialize_distributed(cluster: ClusterSpec, task_index: int,
+                                 init_retries: int = 0,
+                                 init_backoff_s: float = 2.0,
+                                 init_timeout_s: float = 0.0) -> bool:
     """Multi-host sync mode: join the JAX coordination service over DCN.
 
     Worker 0's host acts as coordinator (the role the chief's master service
     plays in the reference). Single-host runs skip this entirely. Returns
     True if distributed init happened.
+
+    ``init_retries`` > 0 arms the crash-restart recovery path: a worker
+    relaunched after a crash retries the join with linear backoff
+    (``init_backoff_s``) while the coordinator comes back, instead of
+    dying on the first connection refusal. ``init_timeout_s`` > 0 caps
+    each attempt's in-library wait (jax's ``initialization_timeout``,
+    default 300 s) so retry attempts turn over fast enough to matter.
     """
     workers = cluster.worker_hosts
     if len(workers) <= 1:
         return False
     import jax
 
+    # CPU multi-process (the distributed-without-a-cluster test topology,
+    # SURVEY.md §4): newer jaxlib defaults the CPU collectives
+    # implementation to "none", which turns every cross-host psum into
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    # Opt into gloo BEFORE backend init; real TPU platforms are untouched.
+    if (jax.config.jax_platforms or "").startswith("cpu"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — older jax: no such flag, no need
+            pass
+
     coordinator = workers[0]
-    jax.distributed.initialize(
+    kwargs = dict(
         coordinator_address=coordinator,
         num_processes=len(workers),
         process_id=task_index,
     )
+    if init_timeout_s and init_timeout_s > 0:
+        kwargs["initialization_timeout"] = int(init_timeout_s)
+
+    def _init():
+        try:
+            jax.distributed.initialize(**kwargs)
+        except TypeError:
+            # older jax without initialization_timeout: library default
+            kwargs.pop("initialization_timeout", None)
+            jax.distributed.initialize(**kwargs)
+
+    def _cleanup():
+        # a failed connect leaves global_state.client set; a bare retry
+        # would then raise "should only be called once" — tear the
+        # half-initialized state down first (best-effort on every field)
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 — half-connected client
+            pass
+        state = getattr(jax.distributed, "global_state", None)
+        if state is not None:
+            for field_name in ("client", "service",
+                               "preemption_sync_manager"):
+                try:
+                    setattr(state, field_name, None)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    _initialize_with_retry(
+        _init, retries=max(0, int(init_retries)),
+        backoff_s=float(init_backoff_s),
+        what=f"jax.distributed.initialize({coordinator})",
+        cleanup_fn=_cleanup)
     return True

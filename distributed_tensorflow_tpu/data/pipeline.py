@@ -74,8 +74,14 @@ def prefetch_to_device(
         return False
 
     def _worker():
+        from distributed_tensorflow_tpu.utils.faults import fault_point
+
         try:
-            for batch in it:
+            for count, batch in enumerate(it):
+                # injection seam for worker-death semantics: an exception
+                # here must reach the consumer as that exception — not a
+                # hang and not a silent short epoch
+                fault_point("prefetch", count=count)
                 item = stage(batch) if stage_on_worker else batch
                 if stop.is_set() or not _send(item):
                     return

@@ -394,7 +394,49 @@ def define_reference_flags():
                    "written off-thread; training never blocks on the "
                    "disk). The final checkpoint on exit is always "
                    "synchronous")
+    DEFINE_string("fault_spec", "", "Deterministic fault injection "
+                  "(utils/faults.py): comma-separated rules, each "
+                  "point[:key=value]... — e.g. "
+                  "'ckpt_write:at_step=40:mode=crash', "
+                  "'restore:mode=torn_file', 'init:mode=refuse:times=2'. "
+                  "Empty (default) injects nothing and leaves every path "
+                  "byte-identical in behavior; the DTT_FAULT_SPEC env "
+                  "var is the fallback for subprocesses. "
+                  "'python tools/trace_ops.py --faults' lists the points")
+    DEFINE_integer("init_retries", 8, "Bounded retries around "
+                   "jax.distributed.initialize for a worker relaunched "
+                   "after a crash (the coordinator may still be coming "
+                   "back); linear backoff of --init_backoff_s per "
+                   "attempt, loud failure when exhausted. 0 = fail on "
+                   "the first refusal (the pre-recovery behavior)")
+    DEFINE_float("init_backoff_s", 2.0, "Backoff unit (seconds) between "
+                 "--init_retries attempts; attempt k waits k*this, "
+                 "capped at 30s")
+    DEFINE_float("init_timeout_s", 0.0, "Per-attempt cap (seconds) on "
+                 "jax.distributed.initialize's own connection wait "
+                 "(0 = the library default, 300s); lower it so "
+                 "--init_retries attempts turn over quickly in "
+                 "fast-relaunch deployments")
     FLAGS._register_validator(_validate_pipeline_flags)
+    FLAGS._register_validator(_validate_fault_spec)
+
+
+def _validate_fault_spec(values: dict):
+    """Parse-time --fault_spec validation: a typo'd injection point or
+    mode surfaces at the command line with the registered-point list, not
+    as a silently-never-firing rule mid-run."""
+    spec = values.get("fault_spec") or ""
+    if not spec:
+        return
+    from distributed_tensorflow_tpu.utils.faults import (
+        FaultSpecError,
+        parse_fault_spec,
+    )
+
+    try:
+        parse_fault_spec(spec)
+    except FaultSpecError as e:
+        raise ValueError(f"--fault_spec: {e}") from None
 
 
 def _validate_pipeline_flags(values: dict):

@@ -117,6 +117,22 @@ def build_model_for(FLAGS, meta: dict):
     )
 
 
+def _log_recovery(sv, logger, step: int) -> None:
+    """Recovery observability: where this run's state came from
+    (restore source step, fallback depth, quarantine count, time-to-
+    restore — sv.restore_report, written by the verified-restore ladder).
+    Emitted once per run into metrics.jsonl + the event file; a fresh
+    init logs restore_step=-1 so 'never restored' and 'restored step 0'
+    stay distinguishable."""
+    rep = getattr(sv, "restore_report", None)
+    logger.scalars(step, {
+        "recovery_restore_step": float(rep.step) if rep else -1.0,
+        "recovery_fallback_depth": float(rep.fallback_depth) if rep else 0.0,
+        "recovery_quarantined": float(len(rep.quarantined)) if rep else 0.0,
+        "recovery_time_s": round(rep.time_s, 4) if rep else 0.0,
+    })
+
+
 def train(FLAGS, mode: str = "local") -> TrainResult:
     """Run a full training job in "local" or "sync" mode.
 
@@ -128,6 +144,9 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     draws from an independently-seeded shuffle, matching the reference's
     per-worker input semantics (``MNISTDist.py:167,178``).
     """
+    from distributed_tensorflow_tpu.utils import faults
+
+    faults.configure_from_flags(FLAGS)
     n_procs = jax.process_count()
     span = bool(getattr(FLAGS, "sp_span_hosts", False))
     if span and not getattr(FLAGS, "seq_parallel", False):
@@ -571,6 +590,7 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
 
     with sv.managed(state) as box:
         state, step = box.state, box.step
+        _log_recovery(sv, logger, step)
         periodic_eval.prime(step)
         if restage is not None:
             # a restored checkpoint arrives as host arrays; re-place it on
@@ -602,11 +622,16 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                     jax.profiler.start_trace(FLAGS.profile_dir)
                     profiling = True
                     profile_stop_at = step + FLAGS.profile_steps
-                state, _ = step_fn(state, batch)
+                state, step_m = step_fn(state, batch)
                 step += 1
                 meter.step()
                 if sync_every and step % sync_every == 0:
-                    jax.block_until_ready(state.params)
+                    # block on the metrics too: their tiny pmeans can
+                    # still be in flight after the params' all-reduce
+                    # completes, and a next program's gloo ops
+                    # interleaving with them crashes the TCP pair
+                    # (multi-process CPU; see collective_sync_cadence)
+                    jax.block_until_ready((state.params, step_m))
                 if not compile_done:
                     # first step carries XLA compile; keep it out of the
                     # throughput window
@@ -1064,6 +1089,7 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
 
     with sv.managed(state) as box:
         step = box.step
+        _log_recovery(sv, logger, step)
         periodic_eval.prime(step)
         pp_state = shard_state_pp(box.state, mesh, virtual_stages=vstages)
         compile_done = False
@@ -1178,6 +1204,7 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
 
     with sv.managed(state) as box:
         step = box.step
+        _log_recovery(sv, logger, step)
         periodic_eval.prime(step)
         pp_state = shard_state_pp(box.state, mesh, virtual_stages=vstages)
         host = box.state
@@ -1346,6 +1373,7 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
 
     with sv.managed(state) as box:
         state, step = box.state, box.step
+        _log_recovery(sv, logger, step)
         periodic_eval.prime(step)
         if restage is not None:
             # a restored checkpoint arrives as host arrays; re-place it on
@@ -1381,7 +1409,10 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
             meter.step(length * FLAGS.batch_size)
             chunks_done += 1
             if sync_every and chunks_done % max(1, sync_every // chunk) == 0:
-                jax.block_until_ready(state.params)
+                # metrics included: their in-flight pmeans must not
+                # interleave with the next program's gloo ops (see
+                # collective_sync_cadence)
+                jax.block_until_ready((state.params, train_m))
             if not compile_done:
                 jax.block_until_ready(state.params)
                 meter.reset()

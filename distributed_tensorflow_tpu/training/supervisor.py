@@ -15,6 +15,7 @@ import contextlib
 import signal
 
 from distributed_tensorflow_tpu.checkpoint import Checkpointer
+from distributed_tensorflow_tpu.utils.faults import fault_point
 
 
 class _CancelGate:
@@ -79,6 +80,9 @@ class Supervisor:
             max_to_keep=max_to_keep, background=background_save,
         )
         self._stop = False
+        # recovery observability: the checkpoint.RestoreReport of the last
+        # init_or_restore (None until then / on a fresh init)
+        self.restore_report = None
 
     def should_stop(self) -> bool:
         return self._stop
@@ -101,24 +105,42 @@ class Supervisor:
         nothing: full-state checkpoints are a superset of the ps layout,
         and restore ignores extra keys.)
 
-        A ``FileNotFoundError`` mid-restore means a sharded set that was
-        complete at selection time vanished under us (a racing peer's
-        GC deleted it between ``latest_checkpoint`` and the read —
-        ``checkpoint_keys``/``load_flat_sharded`` both raise it). That
-        is a transient of healthy concurrent operation, not a broken
-        run: re-scan — the next ``latest_checkpoint`` pass no longer
-        sees the vanished set and picks the newest OLDER complete
-        checkpoint. Bounded so a genuinely sick directory still fails
+        The restore runs through the VERIFIED fallback ladder
+        (checkpoint.restore_with_fallback): the per-array CRC manifest
+        is checked, a corrupt/torn/mixed newest set is quarantined to
+        ``*.corrupt`` and the next-older complete set restores instead,
+        and a set that vanishes mid-read under a racing peer's GC is
+        re-scanned — loud failure only when the ladder is exhausted.
+        ``self.restore_report`` (a checkpoint.RestoreReport, or None on
+        a fresh init) records where the state actually came from; the
+        loops emit it as the ``recovery_*`` scalars.
+
+        The outer FileNotFoundError retry survives the one raiser the
+        ladder does not cover: ``_latest_is_params_only``'s
+        ``checkpoint_keys`` read on the ps-layout fallback path, where a
+        racing peer's GC can delete the set between selection and the
+        key scan. Bounded so a genuinely sick directory still fails
         loudly."""
+        state = step = None
         for attempt in range(2):
             try:
-                return self._init_or_restore_once(init_state)
+                state, step = self._init_or_restore_once(init_state)
+                break
             except FileNotFoundError as e:
                 print(f"checkpoint vanished mid-restore (racing peer "
-                      f"GC?): {e} — re-scanning for an older complete "
-                      f"checkpoint (attempt {attempt + 1}/3)")
-        # third and final attempt: an error here is the loud exit
-        return self._init_or_restore_once(init_state)
+                      f"GC?): {e} — re-scanning (attempt "
+                      f"{attempt + 1}/3)")
+        else:
+            # third and final attempt: an error here is the loud exit
+            state, step = self._init_or_restore_once(init_state)
+        self.restore_report = self.checkpointer.last_restore_report
+        rep = self.restore_report
+        if rep is not None:
+            print(f"restored checkpoint step={rep.step} "
+                  f"(fallback_depth={rep.fallback_depth}, "
+                  f"quarantined={len(rep.quarantined)}, "
+                  f"time={rep.time_s:.2f}s)")
+        return state, step
 
     def _init_or_restore_once(self, init_state):
         try:
@@ -208,11 +230,16 @@ class Supervisor:
             needs_collective_fetch,
         )
 
+        fault_point("collective_fetch", step=step)
         if self.sharded_spanning and needs_collective_fetch(state):
             self.checkpointer.save_sharded(state, step, attempt=attempt)
             return
         if self.is_chief:
             flat = flatten_pytree(state, tag_bf16=True)
+            # injection seam between the fetch and the gated write: a
+            # mode=delay rule here forces the fetch to complete AFTER a
+            # bounded caller abandoned it — the discard path below
+            fault_point("cancel_gate", step=step)
             with (cancelled.lock if cancelled is not None
                   else _ctx.nullcontext()):
                 if cancelled is not None and cancelled.cancelled:

@@ -40,6 +40,94 @@ def _crc32c(data: bytes) -> int:
     return crc ^ 0xFFFFFFFF
 
 
+# Bulk CRC-32C for the checkpoint manifests (checkpoint/checkpoint.py):
+# the scalar loop above is the REFERENCE implementation (~3 MB/s — fine
+# for event-frame headers, hopeless for multi-MB parameter arrays).
+# ``crc32c`` computes the identical checksum at bulk speed: the
+# google_crc32c C kernel when the container ships it, else a numpy
+# chunk-parallel evaluation of the same table recurrence.
+#
+# The numpy path exploits CRC's GF(2) linearity: with
+# R(s, m) = the raw table recurrence folded over message m from state s,
+# R(s, a||b) = R(s, b') where consuming b from state s splits as
+# R(s, 0^len(b)) xor R(0, b) (the update is linear in (state, byte)
+# jointly: T[x^y] = T[x]^T[y]). So the message is cut into fixed-length
+# chunks, every chunk's R(0, chunk) is computed SIMULTANEOUSLY (one
+# vectorized recurrence over a K-wide state vector — L numpy ops total,
+# not n scalar ops), and the per-chunk results fold together through the
+# cached linear "advance the state over L zero bytes" operator, stored as
+# 4x256 byte-indexed tables. Pinned equal to the scalar loop by
+# tests/test_faults.py across sizes and against the standard check value
+# crc32c("123456789") = 0xe3069283.
+
+try:  # optional C kernel (present in this container; never required)
+    import google_crc32c as _google_crc32c
+except ImportError:  # pragma: no cover — exercised where absent
+    _google_crc32c = None
+
+_CRC_CHUNK_LEN = 1024  # measured sweet spot: ~45 MB/s on 13 MB inputs
+                       # (4096 was recurrence-overhead-bound at ~15 MB/s)
+_ZERO_TABLE_CACHE: dict[int, "object"] = {}
+
+
+def _zero_advance_tables(length: int):
+    """4x256 uint32 tables for the linear map s -> R(s, 0^length)."""
+    import numpy as np
+
+    tables = _ZERO_TABLE_CACHE.get(length)
+    if tables is None:
+        t32 = np.asarray(_CRC_TABLE, dtype=np.uint32)
+        vals = np.arange(256, dtype=np.uint32)
+        s = np.concatenate([vals << np.uint32(8 * p) for p in range(4)])
+        for _ in range(length):
+            s = t32[s & np.uint32(0xFF)] ^ (s >> np.uint32(8))
+        tables = s.reshape(4, 256)
+        _ZERO_TABLE_CACHE[length] = tables
+    return tables
+
+
+def _crc32c_numpy(u8) -> int:
+    """Chunk-parallel CRC-32C of a 1-D uint8 array (see note above)."""
+    import numpy as np
+
+    t32 = np.asarray(_CRC_TABLE, dtype=np.uint32)
+    crc = 0xFFFFFFFF
+    n = int(u8.size)
+    L = _CRC_CHUNK_LEN
+    pos = (n // L) * L
+    if n // L >= 2:
+        # columns contiguous so the L-iteration recurrence streams
+        cols = np.ascontiguousarray(u8[:pos].reshape(n // L, L).T)
+        s = np.zeros(n // L, np.uint32)
+        for j in range(L):
+            s = t32[(s ^ cols[j]) & np.uint32(0xFF)] ^ (s >> np.uint32(8))
+        z0, z1, z2, z3 = _zero_advance_tables(L)
+        for r in s.tolist():
+            crc = (int(z0[crc & 0xFF]) ^ int(z1[(crc >> 8) & 0xFF])
+                   ^ int(z2[(crc >> 16) & 0xFF]) ^ int(z3[crc >> 24]) ^ r)
+    else:
+        pos = 0
+    for b in u8[pos:].tolist():
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def crc32c(data) -> int:
+    """CRC-32C (Castagnoli) of ``data`` (bytes-like or ndarray), equal to
+    ``_crc32c`` at bulk speed — the checkpoint manifests' checksum."""
+    import numpy as np
+
+    if isinstance(data, np.ndarray):
+        u8 = np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+    else:
+        u8 = np.frombuffer(data, dtype=np.uint8)
+    if _google_crc32c is not None:
+        # the C extension consumes the ndarray's buffer directly — no
+        # tobytes() copy of multi-MB parameter arrays per checkpoint
+        return int(_google_crc32c.value(u8))
+    return _crc32c_numpy(u8)
+
+
 def _masked_crc(data: bytes) -> int:
     crc = _crc32c(data)
     return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF

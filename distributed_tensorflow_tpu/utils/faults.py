@@ -1,0 +1,278 @@
+"""Deterministic fault injection for the robustness-claiming layers.
+
+Every layer that promises recovery — checkpoint write/GC, restore/decode,
+the Supervisor exit protocol, ``jax.distributed.initialize``,
+``prefetch_to_device`` — calls a NAMED injection point at its critical
+moment. A ``--fault_spec`` (or the ``DTT_FAULT_SPEC`` env var, which
+reaches subprocesses the flag cannot) arms rules against those points, so
+every failure mode the recovery code claims to survive is a reproducible
+one-liner instead of a hand-rolled monkeypatch:
+
+    --fault_spec ckpt_write:at_step=40:mode=crash
+    --fault_spec restore:mode=torn_file
+    --fault_spec init:mode=refuse:times=2
+    --fault_spec "ckpt_write:mode=bitflip,prefetch:at_count=3:mode=error"
+
+Grammar: comma-separated rules; each rule is ``point[:key=value]...``.
+Keys: ``mode`` (what happens — default ``error``), ``at_step``/``at_count``
+(fire only when the site reports that step/count), ``after`` (skip the
+first N matching hits), ``times`` (fire at most N times; 0 = unlimited;
+default 1), ``delay`` (seconds, for ``mode=delay``).
+
+Modes:
+  crash      os._exit(FAULT_EXIT_CODE) — a hard machine-crash analog: no
+             atexit, no finally, no final checkpoint.
+  error      raise InjectedFault at the site (``refuse`` is an alias —
+             the connection-refused analog for the ``init`` point).
+  torn_file  truncate the file the site names (ctx ``path``) to half its
+             bytes — the torn-write signature; execution continues.
+  zero_file  truncate that file to zero bytes; execution continues.
+  bitflip    flip one bit mid-file; execution continues.
+  delay      sleep ``delay`` seconds (default 1.0) — the slow-peer analog
+             for the bounded exit-protocol paths.
+
+With no spec configured ``fault_point`` is a no-op (one list check), so
+the production paths are byte-identical in behavior to an unarmed build.
+This module imports no jax and is safe at any layer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+# the registry of every injection point threaded through the tree — the
+# one discoverable list (``python tools/trace_ops.py --faults`` prints it).
+# A spec naming anything else is rejected at parse time.
+INJECTION_POINTS: dict[str, str] = {
+    "ckpt_write": "after a checkpoint file lands on disk (monolithic npz "
+                  "or one shard), BEFORE the index write and GC "
+                  "[ctx: path, step]",
+    "ckpt_index": "before the checkpoint index file is atomically "
+                  "replaced [ctx: step]",
+    "ckpt_gc": "at entry of checkpoint garbage collection [ctx: -]",
+    "restore": "before a checkpoint file is read back (both formats) "
+               "[ctx: path, step]",
+    "exit_agreement": "inside the bounded exit-agreement allgather "
+                      "(runs on its run_bounded thread) [ctx: clean]",
+    "collective_fetch": "in Supervisor._coordinated_save before the "
+                        "state fetch / sharded save [ctx: step]",
+    "cancel_gate": "between the exit fetch and the cancel-gated write "
+                   "[ctx: step]",
+    "init": "before jax.distributed.initialize in "
+            "cluster.maybe_initialize_distributed [ctx: attempt]",
+    "prefetch": "in prefetch_to_device's staging thread, once per batch "
+                "[ctx: count]",
+}
+
+MODES = ("crash", "error", "refuse", "torn_file", "zero_file", "bitflip",
+         "delay")
+_FILE_MODES = ("torn_file", "zero_file", "bitflip")
+
+FAULT_EXIT_CODE = 17  # the injected hard-crash exit status
+
+
+class InjectedFault(RuntimeError):
+    """The error raised by mode=error/refuse — never raised by real code,
+    so tests and harnesses can assert the failure was the injected one."""
+
+
+class FaultSpecError(ValueError):
+    """A --fault_spec string that doesn't parse (unknown point/mode/key)."""
+
+
+@dataclass
+class FaultRule:
+    point: str
+    mode: str = "error"
+    at_step: int | None = None
+    at_count: int | None = None
+    after: int = 0
+    times: int = 1  # 0 = unlimited
+    delay: float = 1.0
+    # mutable runtime counters
+    hits: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
+
+
+_INT_KEYS = ("at_step", "at_count", "after", "times")
+
+
+def parse_fault_spec(spec: str) -> list[FaultRule]:
+    """``spec`` -> rules; raises FaultSpecError with the grammar on any
+    mistake (this also backs the parse-time flag validator, so a typo
+    surfaces at the command line, not mid-run)."""
+    rules: list[FaultRule] = []
+    for part in (p.strip() for p in (spec or "").split(",")):
+        if not part:
+            continue
+        tokens = part.split(":")
+        point = tokens[0].strip()
+        if point not in INJECTION_POINTS:
+            raise FaultSpecError(
+                f"unknown injection point {point!r}; registered points: "
+                f"{', '.join(sorted(INJECTION_POINTS))} (see "
+                f"tools/trace_ops.py --faults)")
+        rule = FaultRule(point=point)
+        for tok in tokens[1:]:
+            if "=" not in tok:
+                raise FaultSpecError(
+                    f"bad token {tok!r} in rule {part!r}: expected "
+                    f"key=value (grammar: point[:key=value]...)")
+            key, val = (s.strip() for s in tok.split("=", 1))
+            if key == "mode":
+                if val not in MODES:
+                    raise FaultSpecError(
+                        f"unknown mode {val!r} in rule {part!r}; modes: "
+                        f"{', '.join(MODES)}")
+                rule.mode = val
+            elif key in _INT_KEYS:
+                try:
+                    setattr(rule, key, int(val))
+                except ValueError:
+                    raise FaultSpecError(
+                        f"{key}={val!r} in rule {part!r}: expected an "
+                        f"integer") from None
+            elif key == "delay":
+                try:
+                    rule.delay = float(val)
+                except ValueError:
+                    raise FaultSpecError(
+                        f"delay={val!r} in rule {part!r}: expected "
+                        f"seconds") from None
+            else:
+                raise FaultSpecError(
+                    f"unknown key {key!r} in rule {part!r}; keys: mode, "
+                    f"{', '.join(_INT_KEYS)}, delay")
+        rules.append(rule)
+    return rules
+
+
+_LOCK = threading.Lock()
+_RULES: list[FaultRule] = []
+_ENV_CHECKED = False
+
+
+def configure(spec: str | None) -> list[FaultRule]:
+    """Arm (or with None/'' disarm) the injection rules for this process."""
+    global _RULES, _ENV_CHECKED
+    with _LOCK:
+        _RULES = parse_fault_spec(spec) if spec else []
+        _ENV_CHECKED = True  # an explicit configure overrides the env var
+    return _RULES
+
+
+def configure_from_flags(FLAGS) -> list[FaultRule]:
+    """The one flag->feature mapping for ``--fault_spec``; an empty flag
+    falls back to the DTT_FAULT_SPEC env var (the way a test harness arms
+    a subprocess it doesn't own the argv of)."""
+    spec = getattr(FLAGS, "fault_spec", "") or os.environ.get(
+        "DTT_FAULT_SPEC", "")
+    return configure(spec)
+
+
+def reset() -> None:
+    """Disarm everything and forget the env check (test isolation)."""
+    global _RULES, _ENV_CHECKED
+    with _LOCK:
+        _RULES = []
+        _ENV_CHECKED = False
+
+
+def active() -> bool:
+    return bool(_RULES)
+
+
+def _corrupt_file(path: str, mode: str) -> None:
+    size = os.path.getsize(path)
+    if mode == "zero_file":
+        with open(path, "r+b") as f:
+            f.truncate(0)
+    elif mode == "torn_file":
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 2))
+    elif mode == "bitflip":
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([(b[0] if b else 0) ^ 0x01]))
+
+
+def fault_point(name: str, **ctx) -> None:
+    """The injection site call. No-op unless a configured rule matches
+    ``name`` and the ctx filters; then performs the rule's mode (which may
+    not return: crash exits the process, error/refuse raises)."""
+    global _ENV_CHECKED
+    if not _RULES:
+        if _ENV_CHECKED:
+            return
+        with _LOCK:
+            if not _ENV_CHECKED:
+                _ENV_CHECKED = True
+                spec = os.environ.get("DTT_FAULT_SPEC", "")
+                if spec:
+                    _RULES[:] = parse_fault_spec(spec)
+        if not _RULES:
+            return
+    for rule in _RULES:
+        if rule.point != name:
+            continue
+        if rule.at_step is not None and ctx.get("step") != rule.at_step:
+            continue
+        if rule.at_count is not None and ctx.get("count") != rule.at_count:
+            continue
+        with _LOCK:
+            rule.hits += 1
+            if rule.hits <= rule.after:
+                continue
+            if rule.times and rule.fired >= rule.times:
+                continue
+            rule.fired += 1
+        _fire(rule, name, ctx)
+
+
+def _fire(rule: FaultRule, name: str, ctx: dict) -> None:
+    desc = f"injected fault at {name} (mode={rule.mode}, ctx={ctx})"
+    if rule.mode == "crash":
+        print(f"{desc}: hard-exiting {FAULT_EXIT_CODE}", flush=True)
+        os._exit(FAULT_EXIT_CODE)
+    if rule.mode in ("error", "refuse"):
+        raise InjectedFault(desc)
+    if rule.mode == "delay":
+        print(f"{desc}: sleeping {rule.delay}s", flush=True)
+        time.sleep(rule.delay)
+        return
+    if rule.mode in _FILE_MODES:
+        path = ctx.get("path")
+        if not path:
+            raise InjectedFault(
+                f"{desc}: mode {rule.mode!r} needs a file but injection "
+                f"point {name!r} reports no path")
+        _corrupt_file(path, rule.mode)
+        print(f"{desc}: corrupted {path}", flush=True)
+        return
+    raise AssertionError(f"unhandled fault mode {rule.mode!r}")
+
+
+def describe_points() -> str:
+    """Human-readable registry (tools/trace_ops.py --faults)."""
+    lines = ["registered fault-injection points "
+             "(--fault_spec point[:key=value]...[,rule...]):", ""]
+    width = max(len(n) for n in INJECTION_POINTS)
+    for pname in sorted(INJECTION_POINTS):
+        lines.append(f"  {pname:<{width}}  {INJECTION_POINTS[pname]}")
+    lines += [
+        "",
+        f"modes: {', '.join(MODES)}",
+        "keys:  mode, at_step, at_count, after, times (0=unlimited), delay",
+        "examples:",
+        "  --fault_spec ckpt_write:at_step=40:mode=crash",
+        "  --fault_spec restore:mode=torn_file",
+        "  --fault_spec init:mode=refuse:times=2",
+        "  DTT_FAULT_SPEC=prefetch:at_count=3:mode=error  (env var form "
+        "for subprocesses)",
+    ]
+    return "\n".join(lines)

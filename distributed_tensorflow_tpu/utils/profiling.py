@@ -47,7 +47,15 @@ def collective_sync_cadence(multi_device: bool) -> int:
     mesh programs can interleave across device threads and deadlock the
     rendezvous (observed at ~60 deep on an 8-device host — PERF.md). TPU
     streams execute strictly in enqueue order per chip, so no cap there.
+
+    MULTI-PROCESS CPU (the gloo test topology) is stricter still: two
+    in-flight cross-host programs can interleave their gloo sends on one
+    TCP pair and crash the transport with a preamble/size mismatch
+    (``op.preamble.length <= op.nbytes`` abort, observed r8) — so at most
+    ONE collective program may be in flight: cadence 1.
     """
     if not multi_device:
         return 0
-    return 16 if jax.default_backend() == "cpu" else 0
+    if jax.default_backend() == "cpu":
+        return 1 if jax.process_count() > 1 else 16
+    return 0

@@ -159,8 +159,13 @@ def agree_clean_exit(clean: bool, timeout_s: float = 60.0,
     mine = secrets.randbits(31)
 
     def _gather():
+        from distributed_tensorflow_tpu.utils.faults import fault_point
         from jax.experimental import multihost_utils
 
+        # injection seam for the exit protocol: mode=error makes the
+        # agreement fail (verdict None -> save skipped symmetrically);
+        # mode=delay simulates the slow peer run_bounded's grace covers
+        fault_point("exit_agreement", clean=clean)
         rows = multihost_utils.process_allgather(
             np.asarray([1 if clean else 0, mine], np.int32))
         rows = np.asarray(rows).reshape(-1, 2)

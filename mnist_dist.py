@@ -34,6 +34,11 @@ FLAGS = flags.FLAGS
 
 
 def main(_):
+    from distributed_tensorflow_tpu.utils import faults
+
+    # arm deterministic fault injection (no-op with no --fault_spec /
+    # DTT_FAULT_SPEC) before any path that carries injection points runs
+    faults.configure_from_flags(FLAGS)
     if FLAGS.eval_only:
         # restore-and-measure, no training, any checkpoint layout — runs
         # before role dispatch so it works regardless of cluster flags
@@ -76,9 +81,16 @@ def main(_):
 
     if mode == "sync":
         # multi-host sync DP: join the coordination service BEFORE any jax
-        # device use, so every host sees the global mesh
+        # device use, so every host sees the global mesh. The retry knobs
+        # are the crash-restart recovery path: a relaunched worker waits
+        # (bounded) for the coordinator to come back instead of dying on
+        # the first connection refusal.
         cluster = ClusterSpec.from_flags(FLAGS)
-        maybe_initialize_distributed(cluster, FLAGS.task_index)
+        maybe_initialize_distributed(
+            cluster, FLAGS.task_index,
+            init_retries=FLAGS.init_retries,
+            init_backoff_s=FLAGS.init_backoff_s,
+            init_timeout_s=FLAGS.init_timeout_s)
 
     import jax
 

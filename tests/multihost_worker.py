@@ -47,6 +47,12 @@ def _init_cluster(process_id: int, num_processes: int, port: str,
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_default_matmul_precision", "highest")
+    try:
+        # newer jaxlib defaults CPU collectives to "none" — every
+        # cross-host psum would raise; gloo is the multi-process CPU path
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{port}",
         num_processes=num_processes,
@@ -242,6 +248,62 @@ def run_span_mixed_exit(process_id: int, num_processes: int, port: str,
     jax.distributed.shutdown()
 
 
+def run_train_crash(process_id: int, num_processes: int, port: str,
+                    outdir: str) -> None:
+    """The r8 crash-restart chaos worker: the PRODUCT's cluster-join path
+    (cluster.maybe_initialize_distributed with bounded retry/backoff —
+    not the test-harness direct jax.distributed.initialize), then the
+    --device_data production loop. Faults arrive via the DTT_FAULT_SPEC
+    env var (the pytest side arms ckpt_write:mode=crash on the chief for
+    the crash phase, init:mode=refuse:times=1 on the relaunched worker to
+    pin the retry path). --device_data makes the trajectory a pure
+    function of the checkpointed state (batches sampled on device from
+    state.rng), so a crashed-and-relaunched run's final params must match
+    an uninterrupted run's BITWISE."""
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    from distributed_tensorflow_tpu.cluster import (
+        ClusterSpec,
+        maybe_initialize_distributed,
+    )
+
+    # only workers[0] (the coordinator address) and the count matter
+    spec = ClusterSpec({"ps": [], "worker": [
+        f"127.0.0.1:{port}"] + ["127.0.0.1:1"] * (num_processes - 1)})
+    maybe_initialize_distributed(spec, process_id, init_retries=12,
+                                 init_backoff_s=0.5, init_timeout_s=20)
+    assert jax.process_count() == num_processes
+
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    flags.FLAGS._parse([
+        f"--logdir={outdir}/logs",
+        f"--data_dir={outdir}/no-data",
+        "--training_iter=24",
+        "--batch_size=32",
+        "--display_step=4",
+        "--model=mlp",
+        "--device_data",
+        "--device_chunk=4",
+        "--optimizer=adam",
+        "--learning_rate=0.002",
+        "--save_model_secs=1",  # first coord boundary lands a save
+        "--coord_steps=4",
+        "--test_eval=false",
+        f"--task_index={process_id}",
+    ])
+    res = train(flags.FLAGS, mode="sync")
+    assert res.final_step == 24, res
+    print(f"CRASH_RUN_OK p{process_id} step={res.final_step}", flush=True)
+    jax.distributed.shutdown()
+
+
 def run(process_id: int, num_processes: int, port: str, outdir: str) -> None:
     jax = _init_cluster(process_id, num_processes, port)
 
@@ -302,5 +364,6 @@ if __name__ == "__main__":
           "train_sp_lm": run_train_sp_lm,
           "train_sp_span": run_train_sp_span,
           "span_mixed_exit": run_span_mixed_exit,
-          "train_kill": run_train_kill}[mode]
+          "train_kill": run_train_kill,
+          "train_crash": run_train_crash}[mode]
     fn(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5])

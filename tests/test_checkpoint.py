@@ -411,6 +411,12 @@ def test_overlapping_entries_rejected(tmp_path):
     meta["leaves"]["w"]["entries"] = [e, e2]
     arrays["w@1"] = arrays[e["npz"]][:2].copy()
     arrays[e["npz"]] = arrays[e["npz"]][:2].copy()
+    # keep the r8 CRC manifest consistent with the forged arrays so the
+    # POSITIONAL coverage check (not the checksum) is what trips
+    from distributed_tensorflow_tpu.utils.events import crc32c as _crc
+
+    meta["crc32c"] = {k: _crc(np.ascontiguousarray(v))
+                      for k, v in arrays.items()}
     arrays[_SHARDMETA] = np.frombuffer(
         _json.dumps(meta).encode(), dtype=np.uint8)
     np.savez(path, **arrays)

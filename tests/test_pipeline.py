@@ -47,6 +47,65 @@ def test_prefetch_close_terminates_worker():
     assert threading.active_count() <= before
 
 
+def test_prefetch_worker_death_mid_epoch_reaches_consumer():
+    """r8 worker-death semantics: an exception raised by the staging
+    thread MID-epoch (buffered items already queued) reaches the consumer
+    as that exception AFTER the buffered items — not a hang and not a
+    silent short epoch — and the consumer's finally-drain leaves no stuck
+    thread."""
+    from distributed_tensorflow_tpu.utils import faults
+
+    before = threading.active_count()
+    batches = [(np.full(4, i), np.zeros(1)) for i in range(10)]
+    faults.configure("prefetch:at_count=3:mode=error")
+    try:
+        it = prefetch_to_device(iter(batches), size=2)
+        got = []
+        with pytest.raises(faults.InjectedFault):
+            for x, _ in it:
+                got.append(int(np.asarray(x)[0]))
+        # every batch staged before the death was delivered, in order
+        assert got == [0, 1, 2]
+    finally:
+        faults.reset()
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.02)
+    assert threading.active_count() <= before, "staging thread leaked"
+
+
+def test_prefetch_worker_death_with_full_queue_no_hang():
+    """The death lands while the queue is FULL and the consumer is slow:
+    the exception must still arrive (the worker's bounded _send loop keeps
+    offering it), and closing without draining must not leak the
+    thread."""
+    from distributed_tensorflow_tpu.utils import faults
+
+    before = threading.active_count()
+
+    def gen():
+        i = 0
+        while True:
+            yield (np.full(4, i), np.zeros(1))
+            i += 1
+
+    faults.configure("prefetch:at_count=4:mode=error")
+    try:
+        it = prefetch_to_device(gen(), size=2)
+        next(it)
+        time.sleep(0.2)  # let the worker fill the queue and hit the fault
+        with pytest.raises(faults.InjectedFault):
+            for _ in range(10):
+                next(it)
+        it.close()
+    finally:
+        faults.reset()
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.02)
+    assert threading.active_count() <= before, "staging thread leaked"
+
+
 def test_empty_dataset_next_batch_raises():
     ds = DataSet(np.zeros((0, 4), np.float32), np.zeros(0, np.int64))
     with pytest.raises(ValueError, match="empty"):

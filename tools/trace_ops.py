@@ -14,8 +14,13 @@ when V>1), with the per-stage useful-tick fraction and total scheduled
 block-group computations: the masked-tick cost model at a glance, no
 chip required.
 
+``--faults`` lists every registered fault-injection point with the
+--fault_spec grammar (utils/faults.py) — how the spec strings are
+discovered.
+
 Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
        python tools/trace_ops.py --schedule K M [V]
+       python tools/trace_ops.py --faults
 """
 
 from __future__ import annotations
@@ -93,6 +98,18 @@ def print_schedule(k_stages: int, microbatches: int,
           f"{sched.num_ticks * k_stages} x ({per_group} blocks each)")
 
 
+def print_faults() -> None:
+    """List the fault-injection registry (the --fault_spec grammar's
+    source of truth — utils/faults.INJECTION_POINTS)."""
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from distributed_tensorflow_tpu.utils.faults import describe_points
+
+    print(describe_points())
+
+
 def main(profile_dir: str, top_n: int = 25) -> None:
     rows = aggregate(xla_op_events(load_trace(profile_dir)))
     total_us = sum(r["us"] for r in rows)
@@ -115,5 +132,7 @@ if __name__ == "__main__":
         k, m = int(sys.argv[2]), int(sys.argv[3])
         v = int(sys.argv[4]) if len(sys.argv) > 4 else 1
         print_schedule(k, m, v)
+    elif sys.argv[1] == "--faults":
+        print_faults()
     else:
         main(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 25)
