@@ -746,6 +746,153 @@ def convergence_phase(ds, n_chips, target_acc: float | None = None,
     }
 
 
+# Serving drill (r9): the checkpoint-to-traffic path measured HOST-ONLY
+# — a numpy model through the REAL engine/batcher/reload machinery
+# (serving/), so the serving fields stay non-null in the degraded/outage
+# record exactly like the recovery drill. The chip-bound serving numbers
+# (jitted buckets, KV decode) live in tests; this phase evidences the
+# traffic machinery: offered-load latency quantiles, throughput, and the
+# hot-reload blip with a corrupt-newest fallback.
+SERVE_BENCH_REQUESTS = 240
+SERVE_BENCH_CONCURRENCY = 4
+SERVE_BENCH_SWEEP_RPS = (200.0, 800.0)
+
+
+class _ServeBenchModel:
+    """Minimal host model for the serving drill: logits = x @ w + b."""
+
+    @staticmethod
+    def apply(params, x):
+        import numpy as np
+
+        return np.asarray(x) @ params["w"] + params["b"]
+
+
+def serving_phase() -> dict:
+    import os
+    import shutil
+    import sys
+    import tempfile
+
+    import numpy as np
+
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        save_checkpoint,
+    )
+    from distributed_tensorflow_tpu.serving.batcher import DynamicBatcher
+    from distributed_tensorflow_tpu.serving.engine import InferenceEngine
+    from distributed_tensorflow_tpu.serving.server import (
+        make_predict_runner,
+    )
+    from distributed_tensorflow_tpu.utils.metrics import StreamingHistogram
+    from tools.serve_loadgen import run_closed_loop, run_open_loop
+
+    d = tempfile.mkdtemp(prefix="bench-serving-")
+    batcher = None
+    try:
+        rng = np.random.default_rng(0)
+        params = {"w": rng.standard_normal((64, 16)).astype(np.float32),
+                  "b": np.zeros(16, np.float32)}
+        save_checkpoint(d, {"params": params}, 10)
+        save_checkpoint(
+            d, {"params": {**params, "b": params["b"] + 1.0}}, 20)
+
+        engine = InferenceEngine(_ServeBenchModel(), d, jit=False,
+                                 params_template=params, max_batch=8)
+        hist = StreamingHistogram()
+        batcher = DynamicBatcher(make_predict_runner(engine),
+                                 max_batch=8, max_delay_ms=1.0,
+                                 queue_depth=64, latency=hist,
+                                 name="bench-serve")
+        x = rng.standard_normal(64).astype(np.float32)
+        request = lambda: batcher.submit(x).result(10)
+        rep = run_closed_loop(request,
+                              n_requests=SERVE_BENCH_REQUESTS,
+                              concurrency=SERVE_BENCH_CONCURRENCY)
+
+        # offered-load sweep (open loop: arrivals don't slow down with
+        # the server, so the p99 under each offered rate is honest)
+        sweep = []
+        for rate in SERVE_BENCH_SWEEP_RPS:
+            pt = run_open_loop(request, rate_rps=rate, duration_s=1.5)
+            sweep.append({
+                "offered_rps": rate,
+                "achieved_rps": pt["achieved_rps"],
+                "p99_ms": round(pt["latency_ms_p99"], 3),
+                "rejected": pt["rejected"],
+            })
+
+        # hot-reload blip under traffic: a GOOD newer checkpoint swaps
+        # mid-stream; then a TORN newest rides the fallback ladder. The
+        # blip is the swap's wall time; drops must stay zero throughout.
+        import threading
+
+        stop = threading.Event()
+        errors = []
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    batcher.submit(x).result(10)
+                except Exception as e:  # noqa: BLE001 — counted, not raised
+                    errors.append(e)
+
+        threads = [threading.Thread(target=traffic, daemon=True)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            save_checkpoint(
+                d, {"params": {**params, "b": params["b"] + 2.0}}, 30)
+            # the engine/ladder narrate reloads on stdout; bench's
+            # stdout contract is ONE JSON line — route to stderr
+            with contextlib.redirect_stdout(sys.stderr):
+                good = engine.reload_if_newer()
+                save_checkpoint(
+                    d, {"params": {**params, "b": params["b"] + 3.0}},
+                    40)
+                newest = os.path.join(d, "ckpt-40.npz")
+                with open(newest, "r+b") as f:
+                    f.truncate(os.path.getsize(newest) // 2)
+                corrupt = engine.reload_if_newer()
+        finally:
+            # a failure above must not leave the traffic threads
+            # spinning against the closed batcher for the rest of bench
+            stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert good and good.get("swapped"), f"good reload failed: {good}"
+        assert corrupt and not corrupt.get("swapped"), (
+            f"corrupt newest must not swap: {corrupt}")
+        # headline latency/throughput come from the SAME population
+        # (the nominal closed-loop drill); the batcher-level histogram
+        # also saw the deliberately-saturating sweep + reload traffic
+        return {
+            "serving_p50_ms": round(rep["latency_ms_p50"], 3),
+            "serving_p99_ms": round(rep["latency_ms_p99"], 3),
+            "serving_throughput_rps": rep["achieved_rps"],
+            "serving_reload_blip_ms": round(good["reload_ms"], 3),
+            "serving_reload_fallback_depth": corrupt.get(
+                "fallback_depth"),
+            "serving_dropped": len(errors) + rep["errors"],
+            "serving_offered_sweep": sweep,
+        }
+    except Exception as e:  # never kill the record over the drill
+        return {"serving_p50_ms": None,
+                "serving_p99_ms": None,
+                "serving_throughput_rps": None,
+                "serving_reload_blip_ms": None,
+                "serving_reload_fallback_depth": None,
+                "serving_dropped": None,
+                "serving_offered_sweep": None,
+                "serving_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        if batcher is not None:
+            batcher.close(drain=False)
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def recovery_phase() -> dict:
     """Verified-restore drill (r8): save two checkpoints of a small host
     state, TEAR the newest mid-file (the machine-crash signature the
@@ -926,9 +1073,11 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
     # here; `partial` overrides with the measured config when phases
     # ran before the flap)
     out.update(_pp_schedule_facts(2))
-    # the restore-ladder drill is host-only: the recovery fields stay
-    # non-null in EVERY record, outage or not
+    # the restore-ladder and serving drills are host-only: the
+    # recovery_* and serving_* fields stay non-null in EVERY record,
+    # outage or not
     out.update(recovery_phase())
+    out.update(serving_phase())
     if partial:
         out.update(partial)
     if cpu_smoke:
@@ -1029,6 +1178,9 @@ def _run_phases(out: dict):
     # r8: the verified-restore drill (host-only; also runs in the
     # degraded record so the recovery fields are never null)
     out.update(recovery_phase())
+    # r9: the serving drill (host-only for the same reason) — offered
+    # load through the real engine/batcher/hot-reload machinery
+    out.update(serving_phase())
 
     print(json.dumps(out))
 

@@ -648,8 +648,44 @@ def quarantine_step(directory: str, step: int) -> list[str]:
     return _quarantine_paths(paths)
 
 
+def _select_subtree(flat: dict[str, np.ndarray],
+                    subtree: str) -> dict[str, np.ndarray]:
+    """The flat keys under one top-level field of the stored state, with
+    the field prefix stripped (bf16 tags preserved) — how a params-only
+    consumer (the serving engine) restores a FULL TrainState checkpoint
+    against a bare params template, whatever optimizer layout the
+    training run used. Keys outside the subtree vanish; a template key
+    the subset lacks still fails loudly in ``unflatten_pytree``."""
+    prefix = subtree + "/"
+    tagged = _BF16_TAG + prefix
+    out = {}
+    for k, v in flat.items():
+        if k.startswith(prefix):
+            out[k[len(prefix):]] = v
+        elif k.startswith(tagged):
+            out[_BF16_TAG + k[len(tagged):]] = v
+        elif k == subtree:
+            # the subtree IS a single bare leaf: a bare-leaf template
+            # flattens to the empty path key
+            out[""] = v
+        elif k == _BF16_TAG + subtree:
+            out[_BF16_TAG] = v
+    return out
+
+
+def restore_params_with_fallback(directory: str, params_template, *,
+                                 max_rescans: int = 3):
+    """``restore_with_fallback`` against only the ``params`` field of the
+    stored TrainState — the serving engine's restore: same CRC-verified
+    quarantine-and-walk-back ladder, no knowledge of the training run's
+    optimizer-slot layout required. Returns (params, step, RestoreReport)
+    or None."""
+    return restore_with_fallback(directory, params_template,
+                                 max_rescans=max_rescans, subtree="params")
+
+
 def restore_with_fallback(directory: str, template, *,
-                          max_rescans: int = 3):
+                          max_rescans: int = 3, subtree: str | None = None):
     """THE restore ladder: newest checkpoint first, walking back to the
     newest OLDER complete set whenever the pick turns out damaged.
 
@@ -669,7 +705,12 @@ def restore_with_fallback(directory: str, template, *,
     Returns ``(state, step, RestoreReport)``, or None when the directory
     holds no checkpoint at all. Raises CheckpointCorruptError when sets
     existed but every one was quarantined — the ladder exhausting is the
-    one failure that must never look like a fresh init."""
+    one failure that must never look like a fresh init.
+
+    ``subtree`` restricts the unflatten to one top-level field of the
+    stored state (``template`` is then that field's template) — the
+    integrity verification still covers the WHOLE file (a corrupt
+    optimizer slot means the set is damaged, params included)."""
     t0 = time.monotonic()
     depth = 0
     rescans = 0
@@ -716,6 +757,8 @@ def restore_with_fallback(directory: str, template, *,
         # template phase — OUTSIDE the corruption classifier: a missing
         # key (KeyError) or shape mismatch (ValueError) is a structural
         # mismatch with an INTACT file and must stay loud
+        if subtree is not None:
+            flat = _select_subtree(flat, subtree)
         try:
             state = unflatten_pytree(template, flat)
         except KeyError as e:

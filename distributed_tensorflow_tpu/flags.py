@@ -419,6 +419,111 @@ def define_reference_flags():
                  "fast-relaunch deployments")
     FLAGS._register_validator(_validate_pipeline_flags)
     FLAGS._register_validator(_validate_fault_spec)
+    define_serving_flags()
+
+
+def define_serving_flags():
+    """The serving CLI surface (``python -m
+    distributed_tensorflow_tpu.serving``); idempotent, and also defined
+    for the training CLI so one launch-script flag namespace covers the
+    whole lifecycle."""
+    if "serve_port" in FLAGS._defs:
+        return
+    DEFINE_string("serve_host", "127.0.0.1", "Bind address for the "
+                  "serving HTTP front end")
+    DEFINE_integer("serve_port", 8000, "Port for the serving HTTP front "
+                   "end (0 = ephemeral)")
+    DEFINE_integer("serve_max_batch", 8, "Largest microbatch the dynamic "
+                   "batcher assembles; must be a power of two (batches "
+                   "pad to power-of-two buckets so the jitted-executable "
+                   "cache stays one entry per bucket)")
+    DEFINE_float("serve_max_delay_ms", 5.0, "Longest the batcher holds "
+                 "the oldest queued request while waiting to fill a "
+                 "batch — the latency/throughput knob")
+    DEFINE_integer("serve_queue_depth", 64, "Bounded request queue; a "
+                   "full queue REJECTS new requests immediately "
+                   "(backpressure with a reason, never a hang). Must "
+                   "hold at least one full --serve_max_batch")
+    DEFINE_float("serve_timeout_ms", 1000.0, "Default per-request "
+                 "deadline: a request still queued past it completes "
+                 "with a deadline rejection instead of burning chip "
+                 "time on an answer nobody awaits")
+    DEFINE_integer("serve_max_new_tokens", 32, "Default (and cap) for "
+                   "generate requests' new-token budget; prompt + "
+                   "budget must fit the model's context window")
+    DEFINE_float("serve_temperature", 0.0, "Default sampling "
+                 "temperature for generate requests (0 = greedy)")
+    DEFINE_float("serve_reload_secs", 10.0, "Checkpoint-watcher poll "
+                 "cadence: a newer step in --logdir hot-swaps into the "
+                 "engine between microbatches (0 = watching off)")
+    DEFINE_integer("serve_profile_batches", 0, "If > 0, capture one "
+                   "jax.profiler trace around this many served batches "
+                   "and log the artifact path (utils/profiling."
+                   "ServeTraceCapture)")
+    DEFINE_string("serve_profile_dir", "", "Trace directory for "
+                  "--serve_profile_batches (default: <logdir>/"
+                  "serve_profile)")
+    DEFINE_integer("serve_tp", 1, "Tensor-parallel ways for serving "
+                   "placement over the mesh's 'model' axis (Megatron "
+                   "block split via parallel/tensor_parallel); 1 = "
+                   "DP-replicated params. Must divide --num_heads and "
+                   "the MLP width")
+    DEFINE_integer("serve_metrics_every", 50, "Emit serving scalars "
+                   "(queue depth, p50/p99 latency, throughput, reload "
+                   "counters) every this many microbatches (0 = off)")
+    FLAGS._register_validator(_validate_serving_flags)
+
+
+def _validate_serving_flags(values: dict):
+    """Parse-time --serve_* validation (the PR-2 _register_validator
+    pattern): a non-bucketable batch size, an impossible queue bound, or
+    a TP degree the head count can't divide surfaces at the command
+    line, not mid-request."""
+    mb = values.get("serve_max_batch")
+    if mb is None:
+        return  # serving flags not defined in this parse set
+    mb = int(mb)
+    if mb < 1:
+        raise ValueError(f"--serve_max_batch={mb} must be >= 1")
+    if mb & (mb - 1):
+        raise ValueError(
+            f"--serve_max_batch={mb} must be a power of two — batches "
+            f"pad to power-of-two buckets, and a non-bucketable cap "
+            f"would leave its own executable permanently cold")
+    qd = int(values.get("serve_queue_depth") or 0)
+    if qd < mb:
+        raise ValueError(
+            f"--serve_queue_depth={qd} must hold at least one full "
+            f"--serve_max_batch={mb}")
+    if float(values.get("serve_max_delay_ms") or 0.0) < 0:
+        raise ValueError("--serve_max_delay_ms must be >= 0")
+    if float(values.get("serve_timeout_ms") or 0.0) <= 0:
+        raise ValueError("--serve_timeout_ms must be > 0")
+    mnt = values.get("serve_max_new_tokens")
+    if mnt is not None and int(mnt) < 1:
+        raise ValueError("--serve_max_new_tokens must be >= 1")
+    if int(values.get("serve_profile_batches") or 0) < 0:
+        raise ValueError("--serve_profile_batches must be >= 0")
+    if float(values.get("serve_reload_secs") or 0.0) < 0:
+        raise ValueError("--serve_reload_secs must be >= 0")
+    if int(values.get("serve_metrics_every") or 0) < 0:
+        raise ValueError("--serve_metrics_every must be >= 0 (0 = off)")
+    tp = values.get("serve_tp")
+    tp = 1 if tp is None else int(tp)
+    if tp < 1:
+        raise ValueError(f"--serve_tp={tp} must be >= 1")
+    if tp > 1:
+        heads = int(values.get("num_heads") or 0)
+        if heads and heads % tp:
+            raise ValueError(
+                f"--serve_tp={tp} must divide --num_heads={heads} (the "
+                f"attention split is head-aligned)")
+        d_model = int(values.get("d_model") or 0)
+        if d_model and d_model % tp:
+            raise ValueError(
+                f"--serve_tp={tp} must divide --d_model={d_model}")
+    # prompt-vs-context fit is a PER-REQUEST property (prompt lengths
+    # vary); decode.generate enforces it loudly at request time
 
 
 def _validate_fault_spec(values: dict):

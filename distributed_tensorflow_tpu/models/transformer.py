@@ -75,7 +75,14 @@ def _transformer_block(h, blk, attn_fn, cd):
     flavor (dense / blockwise / ring, causal or not) so the block is the
     ONE implementation both model families and every parallelism mode
     run."""
-    h = _attn_half(h, blk, attn_fn, cd)
+    return _mlp_half(_attn_half(h, blk, attn_fn, cd), blk, cd)
+
+
+def _mlp_half(h, blk, cd):
+    """LN -> relu MLP -> residual — the dense block's second half,
+    shared with serving/decode.py's incremental step so the two code
+    paths cannot diverge (the KV-cache bitwise-parity contract rides on
+    this being the one implementation)."""
     y = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
     y = jax.nn.relu(nn.dense(y, blk["mlp_in"]["w"], blk["mlp_in"]["b"],
                              compute_dtype=cd))
@@ -86,11 +93,20 @@ def _transformer_block(h, blk, attn_fn, cd):
 def _attn_half(h, blk, attn_fn, cd):
     """LN -> attention -> residual (shared by the dense-MLP and MoE
     block forms)."""
+    return _attn_half_kv(h, blk, attn_fn, cd)[0]
+
+
+def _attn_half_kv(h, blk, attn_fn, cd):
+    """``_attn_half`` that also hands back this block's (k, v) — the
+    serving prefill captures them into the decode cache, computed by the
+    SAME projection the training forward runs (returns
+    ``(h_out, k, v)``; k/v are (B, S, H, Dh) in the attention input
+    dtype)."""
     y = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
     qkv = jnp.einsum("bsd,dthe->tbshe", y, blk["qkv"].astype(y.dtype))
     a = attn_fn(qkv[0], qkv[1], qkv[2])
     a = a.reshape(*a.shape[:2], -1)  # (B, S, H*Dh)
-    return h + nn.dense(a, blk["proj"], compute_dtype=cd)
+    return h + nn.dense(a, blk["proj"], compute_dtype=cd), qkv[1], qkv[2]
 
 
 def _moe_block_params(w, d, h, dh, mlp_dim, num_experts, dtype):

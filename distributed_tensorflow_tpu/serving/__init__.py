@@ -1,0 +1,49 @@
+"""serving/ — checkpoint-to-traffic inference.
+
+The training stack ends at a verified checkpoint; this package turns one
+into answered requests: ``engine`` (restore-with-fallback + placement +
+per-bucket jitted apply + hot-reload), ``batcher`` (dynamic microbatch
+assembly with deadline-aware admission), ``decode`` (KV-cache
+autoregressive decode, bitwise-consistent with full recompute),
+``server`` (stdlib JSON-over-HTTP + in-process client). Run it:
+
+    python -m distributed_tensorflow_tpu.serving --logdir /tmp/train_logs
+"""
+
+from distributed_tensorflow_tpu.serving.batcher import (
+    DynamicBatcher,
+    Future,
+    RejectedError,
+    pow2_bucket,
+)
+from distributed_tensorflow_tpu.serving.engine import (
+    CheckpointWatcher,
+    InferenceEngine,
+    NoCheckpointError,
+)
+from distributed_tensorflow_tpu.serving.server import (
+    InferenceServer,
+    InProcessClient,
+    ServingMetrics,
+    generate_group_key,
+    make_generate_runner,
+    make_predict_runner,
+    predict_group_key,
+)
+
+__all__ = [
+    "CheckpointWatcher",
+    "DynamicBatcher",
+    "Future",
+    "InferenceEngine",
+    "InferenceServer",
+    "InProcessClient",
+    "NoCheckpointError",
+    "RejectedError",
+    "ServingMetrics",
+    "generate_group_key",
+    "make_generate_runner",
+    "make_predict_runner",
+    "pow2_bucket",
+    "predict_group_key",
+]

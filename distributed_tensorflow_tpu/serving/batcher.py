@@ -1,0 +1,360 @@
+"""Dynamic microbatch assembly with bounded admission — the serving
+front door.
+
+TPU inference wants large, shape-stable batches (one compiled executable
+per bucket); traffic arrives as single requests at arbitrary times. The
+batcher bridges the two the way Orca/TF-Serving-style systems do: a
+bounded request queue, a worker that closes a microbatch when either
+``max_batch`` requests are waiting or the oldest has waited
+``max_delay_ms``, and power-of-two batch padding so the engine's jitted
+executable cache stays small.
+
+Admission is deadline-aware and NEVER hangs the client:
+
+- a full queue rejects immediately (``RejectedError`` with the reason —
+  backpressure the caller can see, retry, or shed),
+- a request whose deadline expires before its batch executes completes
+  with a deadline ``RejectedError`` instead of burning chip time on an
+  answer nobody is waiting for,
+- a dead worker (a batch raising ``BaseException``, e.g. an injected
+  crash) fails every pending future and marks the batcher closed —
+  subsequent submits reject; nothing blocks forever. Per-batch
+  ``Exception``s fail only that batch's futures; the worker keeps
+  serving.
+
+Requests carry a ``group`` key (the engine uses the decode bucket —
+prompt length/opts) so only shape-compatible requests assemble into one
+microbatch; groups are served FIFO by their oldest request.
+
+Fault points (utils/faults.py): ``serve_admit`` fires inside submit
+after the admission checks, ``serve_batch`` after a microbatch is
+assembled — ``--fault_spec serve_batch:mode=error`` proves the
+reject-with-reason path under deterministic failure.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from distributed_tensorflow_tpu.utils.faults import fault_point
+
+
+class RejectedError(RuntimeError):
+    """A request the serving stack declined to run, with the reason
+    (queue full, deadline exceeded, batcher closed, injected fault).
+    Backpressure is a VISIBLE contract: callers get this immediately,
+    never a hang."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class Future:
+    """Single-assignment result slot for one request."""
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error: BaseException | None = None
+
+    def set_result(self, value) -> None:
+        self._value = value
+        self._event.set()
+
+    def set_error(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+@dataclass
+class _Request:
+    payload: Any
+    opts: dict
+    group: Any
+    future: Future
+    t_submit: float
+    deadline: float
+
+
+def pow2_bucket(n: int, cap: int) -> int:
+    """The smallest power of two >= n, clamped to ``cap`` — the batch
+    padding policy (one compiled executable per bucket instead of one
+    per observed batch size)."""
+    if n < 1:
+        raise ValueError(f"bucket of {n} requests")
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, cap)
+
+
+@dataclass
+class BatcherStats:
+    admitted: int = 0
+    completed: int = 0
+    rejected_full: int = 0
+    rejected_closed: int = 0
+    rejected_deadline: int = 0
+    rejected_fault: int = 0
+    failed: int = 0
+    batches: int = 0
+    batched_requests: int = 0
+    queue_depth: int = 0
+    lock: threading.Lock = field(default_factory=threading.Lock,
+                                 repr=False)
+
+    def as_dict(self) -> dict:
+        with self.lock:
+            d = {k: getattr(self, k) for k in (
+                "admitted", "completed", "rejected_full",
+                "rejected_closed", "rejected_deadline",
+                "rejected_fault", "failed",
+                "batches", "batched_requests", "queue_depth")}
+        d["mean_batch_size"] = (d["batched_requests"] / d["batches"]
+                                if d["batches"] else 0.0)
+        return d
+
+
+class DynamicBatcher:
+    """Bounded queue + one worker thread assembling microbatches.
+
+    ``runner(payloads, opts_list) -> results`` executes one assembled
+    microbatch (same-length lists; the engine pads/stacks inside).
+    ``group_key(payload, opts)`` partitions requests into
+    shape-compatible groups (None = everything batches together).
+    ``latency`` (a ``StreamingHistogram``) records per-request
+    end-to-end milliseconds; ``on_batch(stats)`` runs after every batch
+    (the metrics-emission and profiling hooks).
+    """
+
+    def __init__(self, runner: Callable, *, max_batch: int = 8,
+                 max_delay_ms: float = 5.0, queue_depth: int = 64,
+                 default_timeout_ms: float = 1000.0,
+                 group_key: Callable | None = None,
+                 latency=None, on_batch: Callable | None = None,
+                 name: str = "serve"):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if queue_depth < max_batch:
+            raise ValueError(f"queue_depth ({queue_depth}) must hold at "
+                             f"least one full batch ({max_batch})")
+        self._runner = runner
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.queue_depth = int(queue_depth)
+        self.default_timeout_s = float(default_timeout_ms) / 1000.0
+        self._group_key = group_key
+        self.latency = latency
+        self._on_batch = on_batch
+        self.stats = BatcherStats()
+        self._queue: list[_Request] = []
+        self._cv = threading.Condition()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._worker_loop, name=f"{name}-batcher", daemon=True)
+        self._worker.start()
+        # deadlines must fire even while the worker is busy inside a
+        # long batch (otherwise an expired request waits for the batch
+        # to finish before learning it was never going to run)
+        self._expirer = threading.Thread(
+            target=self._expiry_loop, name=f"{name}-expiry", daemon=True)
+        self._expirer.start()
+
+    # ------------------------------------------------------- admission
+
+    def submit(self, payload, timeout_ms: float | None = None,
+               **opts) -> Future:
+        """Admit one request; returns its Future. Raises
+        ``RejectedError`` IMMEDIATELY on a full queue, a closed batcher,
+        or an armed ``serve_admit`` fault — admission never blocks."""
+        now = time.monotonic()
+        timeout_s = (self.default_timeout_s if timeout_ms is None
+                     else float(timeout_ms) / 1000.0)
+        group = (self._group_key(payload, opts)
+                 if self._group_key is not None else None)
+        req = _Request(payload=payload, opts=opts, group=group,
+                       future=Future(), t_submit=now,
+                       deadline=now + timeout_s)
+        with self._cv:
+            if self._closed:
+                # distinct counter: a closed batcher needs a restart, a
+                # full queue needs shedding — an operator must be able
+                # to tell which from the stats
+                with self.stats.lock:
+                    self.stats.rejected_closed += 1
+                raise RejectedError("batcher closed")
+            if len(self._queue) >= self.queue_depth:
+                with self.stats.lock:
+                    self.stats.rejected_full += 1
+                raise RejectedError(
+                    f"queue full (depth={self.queue_depth}); retry later")
+            try:
+                fault_point("serve_admit", count=self.stats.admitted + 1)
+            except Exception as e:
+                with self.stats.lock:
+                    self.stats.rejected_fault += 1
+                raise RejectedError(f"admission fault: {e}") from e
+            self._queue.append(req)
+            with self.stats.lock:
+                self.stats.admitted += 1
+                self.stats.queue_depth = len(self._queue)
+            self._cv.notify_all()
+        return req.future
+
+    # ---------------------------------------------------------- worker
+
+    def _take_batch(self) -> list[_Request] | None:
+        """Block until a batch is ready (or the batcher closes); expire
+        overdue requests while waiting. Returns None only at close."""
+        with self._cv:
+            while True:
+                if self._closed and not self._queue:
+                    return None
+                self._expire_locked()
+                if self._queue:
+                    oldest = self._queue[0]
+                    ready_at = oldest.t_submit + self.max_delay_s
+                    same = [r for r in self._queue
+                            if r.group == oldest.group]
+                    if (len(same) >= self.max_batch or self._closed
+                            or time.monotonic() >= ready_at):
+                        batch = same[:self.max_batch]
+                        taken = set(map(id, batch))
+                        self._queue = [r for r in self._queue
+                                       if id(r) not in taken]
+                        with self.stats.lock:
+                            self.stats.queue_depth = len(self._queue)
+                        return batch
+                    self._cv.wait(max(ready_at - time.monotonic(), 0.0))
+                else:
+                    self._cv.wait(0.1)
+
+    def _expire_locked(self) -> None:
+        now = time.monotonic()
+        keep = []
+        for r in self._queue:
+            if r.deadline <= now:
+                with self.stats.lock:
+                    self.stats.rejected_deadline += 1
+                r.future.set_error(RejectedError(
+                    "deadline exceeded before execution"))
+            else:
+                keep.append(r)
+        if len(keep) != len(self._queue):
+            self._queue = keep
+            with self.stats.lock:
+                self.stats.queue_depth = len(self._queue)
+
+    def _expiry_loop(self) -> None:
+        """Fail overdue queued requests on their deadline, independent of
+        the worker — a worker stuck inside a long batch must not delay
+        'your deadline passed' for everything behind it."""
+        while True:
+            with self._cv:
+                if self._closed and not self._queue:
+                    return
+                self._expire_locked()
+                if self._queue:
+                    wake = min(r.deadline for r in self._queue)
+                    self._cv.wait(
+                        max(wake - time.monotonic(), 0.0) + 1e-3)
+                else:
+                    self._cv.wait(0.05)
+
+    def _worker_loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            try:
+                with self.stats.lock:
+                    self.stats.batches += 1
+                    self.stats.batched_requests += len(batch)
+                    n_batch = self.stats.batches
+                fault_point("serve_batch", count=n_batch,
+                            size=len(batch))
+                results = self._runner([r.payload for r in batch],
+                                       [r.opts for r in batch])
+                if len(results) != len(batch):
+                    raise RuntimeError(
+                        f"runner returned {len(results)} results for "
+                        f"{len(batch)} requests")
+                now = time.monotonic()
+                for r, res in zip(batch, results):
+                    if self.latency is not None:
+                        self.latency.record((now - r.t_submit) * 1e3)
+                    r.future.set_result(res)
+                with self.stats.lock:
+                    self.stats.completed += len(batch)
+                if self._on_batch is not None:
+                    try:
+                        self._on_batch(self)
+                    except Exception as e:  # hooks never kill serving
+                        print(f"serving on_batch hook failed: {e}")
+            except Exception as e:
+                # one bad batch (including an injected serve_batch
+                # fault): fail ITS futures, keep serving
+                with self.stats.lock:
+                    self.stats.failed += len(batch)
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_error(e)
+            except BaseException as e:
+                # worker death (SystemExit and friends): fail the batch
+                # AND everything pending, close — no client ever hangs
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_error(e)
+                self._die(e)
+                return
+
+    def _die(self, error: BaseException) -> None:
+        with self._cv:
+            self._closed = True
+            pending, self._queue = self._queue, []
+            with self.stats.lock:
+                self.stats.queue_depth = 0
+                self.stats.failed += len(pending)
+            self._cv.notify_all()
+        for r in pending:
+            if not r.future.done():
+                r.future.set_error(RejectedError(
+                    f"batcher worker died: {error}"))
+        print(f"serving batcher worker died: {type(error).__name__}: "
+              f"{error}")
+
+    # ----------------------------------------------------------- admin
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the worker. ``drain=True`` serves what is queued first;
+        False rejects the queue."""
+        with self._cv:
+            self._closed = True
+            if not drain:
+                pending, self._queue = self._queue, []
+                for r in pending:
+                    r.future.set_error(RejectedError("batcher closed"))
+                with self.stats.lock:
+                    self.stats.queue_depth = 0
+            self._cv.notify_all()
+        self._worker.join(timeout=30)

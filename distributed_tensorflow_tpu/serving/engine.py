@@ -1,0 +1,335 @@
+"""InferenceEngine: checkpoint-to-traffic, with hot-reload.
+
+The training half of the lifecycle ends at a CRC-manifested checkpoint
+directory; this engine is the other half. It restores the ``params``
+field of the newest TrainState checkpoint through the SAME
+verify–quarantine–fallback ladder the trainer's restore uses
+(``checkpoint.restore_params_with_fallback`` — a corrupt newest set is
+quarantined and the newest older complete set serves instead), places
+the params with the existing mesh machinery (DP-replicated or
+TP-sharded via ``parallel/tensor_parallel.tp_param_specs``), and serves
+through jitted apply functions with power-of-two batch bucketing (one
+cached executable per padded shape) and float input buffers donated.
+
+Hot-reload (TF-Serving's checkpoint-watch/swap model): a
+``CheckpointWatcher`` thread polls the directory; a newer step restores
+through the ladder OFF the serving path, is placed, and the params
+reference swaps atomically between microbatches — in-flight batches
+hold the reference they started with, so nothing is dropped. A newest
+set that turns out corrupt rides the ladder down and the engine keeps
+serving what it has (``serve_reload`` fault point; tests tear the
+newest file there and assert zero dropped requests).
+
+``jit=False`` is the host-only mode: no jax backend is touched — the
+restore, swap, and bucket machinery run pure-numpy against any object
+with ``apply(params, x)``. bench.py's serving phase uses it so serving
+latency/reload evidence survives chip outages, exactly like the
+recovery drill.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+    latest_checkpoint,
+    restore_params_with_fallback,
+)
+from distributed_tensorflow_tpu.utils.faults import fault_point
+
+
+class NoCheckpointError(FileNotFoundError):
+    """Serving needs weights: raised when the logdir holds no restorable
+    checkpoint at engine construction."""
+
+
+class InferenceEngine:
+    """Loads, places, serves, and hot-swaps one model's parameters.
+
+    ``mesh=None`` serves on the default device; with a mesh, ``tp=False``
+    replicates the params over every chip (DP serving — each request
+    batch can split over the data axis) and ``tp=True`` shards them with
+    the Megatron block split (``tp_param_specs``), XLA deriving the
+    collectives. ``params_template`` defaults to ``model.init`` (jax
+    path); host-mode callers pass it explicitly.
+    """
+
+    def __init__(self, model, logdir: str, *, mesh=None, tp: bool = False,
+                 jit: bool = True, params_template=None,
+                 max_batch: int = 8):
+        self.model = model
+        self.logdir = logdir
+        self.mesh = mesh
+        self.tp = bool(tp)
+        self.jit = bool(jit)
+        self.max_batch = int(max_batch)
+        # token-id models (anything with a vocab) take int32 ids; dense
+        # models take floats — the wire always delivers JSON numbers, so
+        # the engine owns the cast
+        self.input_dtype = (np.int32 if hasattr(model, "vocab_size")
+                            else np.float32)
+        self._swap_lock = threading.Lock()
+        self._apply_cache: dict = {}
+        self._decode_cache: dict = {}
+        self._params = None
+        self._step = -1
+        self.counters = {"reloads": 0, "reload_failures": 0,
+                         "reload_fallbacks": 0, "last_reload_ms": 0.0,
+                         "last_fallback_depth": 0}
+        if params_template is None:
+            import jax
+
+            params_template = model.init(jax.random.PRNGKey(0))
+        self._template = params_template
+        out = restore_params_with_fallback(logdir, self._template)
+        if out is None:
+            raise NoCheckpointError(
+                f"no restorable checkpoint in {logdir!r} — serving needs "
+                f"trained weights")
+        params, step, report = out
+        self._params = self._place(params)
+        self._step = step
+        self.restore_report = report
+
+    # ------------------------------------------------------- placement
+
+    def _place(self, params):
+        if not self.jit:
+            return params
+        import jax
+
+        if self.mesh is None:
+            return jax.device_put(params)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_tensorflow_tpu.parallel.tensor_parallel import (
+            _check_divisibility,
+            _map_specs,
+            tp_param_specs,
+        )
+
+        if self.tp:
+            specs = tp_param_specs(params)
+            _check_divisibility(params, specs, self.mesh)
+            return jax.device_put(params,
+                                  _map_specs(params, specs, self.mesh))
+        return jax.device_put(
+            params, jax.tree.map(
+                lambda _: NamedSharding(self.mesh, P()), params))
+
+    def _stage(self, x):
+        """Input placement: batch split over the data axis when the
+        bucket divides it, else replicated (tiny buckets)."""
+        import jax
+
+        if self.mesh is None:
+            return jax.device_put(x)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_tensorflow_tpu.parallel.mesh import (
+            DATA_AXIS,
+            batch_sharding,
+        )
+
+        if x.shape[0] % self.mesh.shape[DATA_AXIS] == 0:
+            return jax.device_put(x, batch_sharding(self.mesh, x.ndim))
+        return jax.device_put(x, NamedSharding(self.mesh, P()))
+
+    # --------------------------------------------------------- serving
+
+    def current(self):
+        """(params, step) — the batch worker reads this ONCE per
+        microbatch; a concurrent hot-swap changes what the NEXT batch
+        sees, never the one in flight."""
+        with self._swap_lock:
+            return self._params, self._step
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    def _bucket(self, n: int) -> int:
+        from distributed_tensorflow_tpu.serving.batcher import pow2_bucket
+
+        return pow2_bucket(n, self.max_batch)
+
+    def _apply_fn(self):
+        """ONE jitted apply wrapper per engine — jax.jit specializes and
+        caches one executable per padded input shape inside it, and the
+        power-of-two bucketing bounds how many shapes it ever sees. The
+        input buffer is DONATED only when it can alias an output
+        (float inputs; an int32 token batch can never alias the float
+        logits, and a dead donation just warns per compile)."""
+        fn = self._apply_cache.get("apply")
+        if fn is None:
+            if self.jit:
+                import jax
+
+                donate = ((1,) if np.issubdtype(self.input_dtype,
+                                                np.floating) else ())
+                fn = jax.jit(lambda p, x: self.model.apply(p, x),
+                             donate_argnums=donate)
+            else:
+                fn = lambda p, x: self.model.apply(p, x)
+            self._apply_cache["apply"] = fn
+        return fn
+
+    def predict(self, x) -> np.ndarray:
+        """Forward one already-stacked batch (B, ...) -> host outputs
+        (B, ...): pads the batch dim to its power-of-two bucket, runs
+        the bucket's cached executable, slices the padding back off."""
+        x = np.asarray(x, dtype=self.input_dtype)
+        b = x.shape[0]
+        bucket = self._bucket(b)
+        if bucket > b:
+            pad = np.zeros((bucket - b, *x.shape[1:]), x.dtype)
+            xb = np.concatenate([x, pad], axis=0)
+        else:
+            xb = x
+        params, _ = self.current()
+        fn = self._apply_fn()
+        if self.jit:
+            out = fn(params, self._stage(xb))
+        else:
+            out = fn(params, xb)
+        return np.asarray(out)[:b]
+
+    def generate(self, prompts, max_new_tokens: int, *,
+                 temperature: float = 0.0, seed: int | None = None) -> dict:
+        """Autoregressive decode of a (B, P) prompt batch through the
+        preallocated KV cache (serving/decode.py) with the current
+        params; per-(bucket, P) cached jitted prefill/step fns.
+
+        ``seed=None`` with temperature > 0 draws fresh entropy per call
+        — identical prompts must NOT return identical "random" samples;
+        pass an explicit seed for reproducible sampling."""
+        from distributed_tensorflow_tpu.serving import decode as dec
+
+        prompts = np.asarray(prompts, dtype=np.int32)
+        b = prompts.shape[0]
+        bucket = max(self._bucket(b), 2)  # decode floor: see decode.py
+        if bucket > b:
+            pad = np.repeat(prompts[-1:], bucket - b, axis=0)
+            prompts_b = np.concatenate([prompts, pad], axis=0)
+        else:
+            prompts_b = prompts
+        # ONE (prefill, step) wrapper pair per engine: both consume
+        # capacity-padded shapes, so neither depends on the prompt
+        # length or bucket — jax.jit specializes per input shape inside
+        # the single wrapper, and a per-key wrapper would recompile the
+        # same executable for every new prompt length
+        fns = self._decode_cache.get("decode")
+        if fns is None:
+            fns = (dec.make_prefill(self.model, jit=self.jit),
+                   dec.make_decode_step(self.model, jit=self.jit))
+            self._decode_cache["decode"] = fns
+        params, _ = self.current()
+        rng = None
+        if temperature > 0.0:
+            import os
+
+            import jax
+
+            if seed is None:
+                seed = int.from_bytes(os.urandom(4), "little")
+            rng = jax.random.PRNGKey(seed)
+        out = dec.generate(self.model, params, prompts_b, max_new_tokens,
+                           temperature=temperature, rng=rng,
+                           prefill_fn=fns[0], step_fn=fns[1])
+        return {"tokens": out["tokens"][:b], "logits": out["logits"][:b]}
+
+    # ------------------------------------------------------ hot-reload
+
+    def reload_if_newer(self) -> dict | None:
+        """One watch tick: if the directory holds a newer step, restore
+        it through the fallback ladder and atomically swap. Returns a
+        report dict, or None when there was nothing newer. NEVER raises
+        on a corrupt newest set — the ladder walks back and the engine
+        keeps serving (a reload must not take down live traffic)."""
+        found = latest_checkpoint(self.logdir)
+        if found is None or found[1] <= self._step:
+            return None
+        path, step = found
+        t0 = time.monotonic()
+        try:
+            fault_point("serve_reload", path=path, step=step)
+            out = restore_params_with_fallback(self.logdir,
+                                               self._template)
+        except Exception as e:
+            # ladder exhausted (CheckpointCorruptError), injected error,
+            # unreadable directory: keep serving what we have
+            self.counters["reload_failures"] += 1
+            print(f"serving reload failed (still serving step "
+                  f"{self._step}): {type(e).__name__}: {e}")
+            return {"swapped": False, "error": str(e), "step": self._step}
+        ms = (time.monotonic() - t0) * 1e3
+        if out is None:
+            self.counters["reload_failures"] += 1
+            return {"swapped": False, "error": "no restorable checkpoint",
+                    "step": self._step}
+        params, rstep, report = out
+        self.counters["last_fallback_depth"] = report.fallback_depth
+        if rstep <= self._step:
+            # the newest set was corrupt; the ladder landed on (at or
+            # below) what we already serve — count it, swap nothing
+            self.counters["reload_fallbacks"] += 1
+            print(f"serving reload: newest checkpoint (step {step}) "
+                  f"failed verification; ladder landed on step {rstep} "
+                  f"— still serving step {self._step}")
+            return {"swapped": False, "step": rstep,
+                    "fallback_depth": report.fallback_depth,
+                    "reload_ms": ms}
+        placed = self._place(params)
+        with self._swap_lock:
+            self._params = placed
+            self._step = rstep
+        self.counters["reloads"] += 1
+        self.counters["last_reload_ms"] = ms
+        print(f"serving hot-reload: now serving step {rstep} "
+              f"(restore+place {ms:.1f} ms, fallback depth "
+              f"{report.fallback_depth})")
+        return {"swapped": True, "step": rstep, "reload_ms": ms,
+                "fallback_depth": report.fallback_depth}
+
+    def stats(self) -> dict:
+        return {"step": self._step, **self.counters}
+
+
+class CheckpointWatcher:
+    """Polls the logdir every ``interval_s`` and hot-swaps through
+    ``engine.reload_if_newer`` — TF-Serving's file-system monitor in one
+    daemon thread. ``check_now()`` runs one tick synchronously (tests
+    and the bench drive it directly)."""
+
+    def __init__(self, engine: InferenceEngine, interval_s: float = 10.0):
+        self.engine = engine
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="serve-ckpt-watcher",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def check_now(self) -> dict | None:
+        return self.engine.reload_if_newer()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.engine.reload_if_newer()
+            except Exception as e:  # the watcher must outlive bad ticks
+                print(f"checkpoint watcher tick failed: {e}")
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

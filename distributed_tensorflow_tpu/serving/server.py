@@ -1,0 +1,307 @@
+"""JSON-over-HTTP front end + in-process client for the serving stack.
+
+Stdlib only (``http.server``): the container bakes no web framework, and
+the protocol is four routes —
+
+    POST /v1/predict   {"inputs": [...]}  ONE example       -> {"outputs"}
+    POST /v1/generate  {"prompt": [ids], "max_new_tokens",
+                        "temperature", "seed"}              -> {"tokens"}
+    GET  /healthz                                           -> {"ok", "step"}
+    GET  /stats                                             -> counters + quantiles
+
+(one example per request BY DESIGN — batching is the server's job,
+across requests, not the client's)
+
+Backpressure maps to status codes a load balancer understands: a
+``RejectedError`` (queue full / deadline / closed) is 429, bad JSON is
+400, anything else 500 — a client is always answered, never hung
+(the batcher's contract carried to the wire).
+
+``InProcessClient`` speaks the same request surface directly against the
+batcher — the test/bench path, and what ``tools/serve_loadgen.py``
+drives when no URL is given.
+
+``ServingMetrics`` is the observability cadence: every ``emit_every``
+microbatches the queue depth, p50/p99 latency, throughput, and reload
+counters land in the SAME JSONL + TensorBoard sinks training scalars use
+(``MetricsLogger``/``utils/events.py``), stepped by batch count.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from distributed_tensorflow_tpu.serving.batcher import (
+    DynamicBatcher,
+    RejectedError,
+)
+
+
+class InProcessClient:
+    """Typed request surface over a predict and/or generate batcher —
+    the engine-side twin of the HTTP routes. Owns the serving-side
+    request policy: the default new-token budget/temperature for
+    requests that omit them (``--serve_max_new_tokens`` /
+    ``--serve_temperature``) and the budget CAP — a request asking for
+    more than ``max_new_tokens_cap`` is rejected loudly (400 on the
+    wire) instead of monopolizing the batch worker."""
+
+    def __init__(self, predict_batcher: DynamicBatcher | None = None,
+                 generate_batcher: DynamicBatcher | None = None, *,
+                 default_max_new_tokens: int = 16,
+                 max_new_tokens_cap: int | None = None,
+                 default_temperature: float = 0.0):
+        self.predict_batcher = predict_batcher
+        self.generate_batcher = generate_batcher
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.max_new_tokens_cap = (None if max_new_tokens_cap is None
+                                   else int(max_new_tokens_cap))
+        self.default_temperature = float(default_temperature)
+
+    def predict(self, x, timeout_ms: float | None = None,
+                wait_s: float = 30.0):
+        if self.predict_batcher is None:
+            raise ValueError(
+                "this server is not configured for predict")
+        fut = self.predict_batcher.submit(np.asarray(x),
+                                          timeout_ms=timeout_ms)
+        return fut.result(wait_s)
+
+    def generate(self, prompt, max_new_tokens: int | None = None,
+                 temperature: float | None = None,
+                 seed: int | None = None,
+                 timeout_ms: float | None = None, wait_s: float = 60.0):
+        if self.generate_batcher is None:
+            raise ValueError(
+                "this server's model does not support generate "
+                "(token decode serves --model lm only)")
+        n = (self.default_max_new_tokens if max_new_tokens is None
+             else int(max_new_tokens))
+        if self.max_new_tokens_cap is not None \
+                and n > self.max_new_tokens_cap:
+            raise ValueError(
+                f"max_new_tokens={n} exceeds the server cap "
+                f"({self.max_new_tokens_cap})")
+        t = (self.default_temperature if temperature is None
+             else float(temperature))
+        fut = self.generate_batcher.submit(
+            np.asarray(prompt, dtype=np.int32), timeout_ms=timeout_ms,
+            max_new_tokens=n, temperature=t,
+            seed=None if seed is None else int(seed))
+        return fut.result(wait_s)
+
+
+def make_predict_runner(engine):
+    """Batcher runner for the predict route: stack the per-request
+    examples, one engine call, unstack."""
+
+    def runner(payloads, opts_list):
+        del opts_list
+        out = engine.predict(np.stack(payloads))
+        return [out[i] for i in range(len(payloads))]
+
+    return runner
+
+
+def make_generate_runner(engine):
+    """Batcher runner for the generate route. Requests are grouped by
+    (prompt length, max_new_tokens, temperature) — see
+    ``generate_group_key`` — so one engine call serves the whole
+    microbatch through one compiled bucket."""
+
+    def runner(payloads, opts_list):
+        o = opts_list[0]
+        out = engine.generate(
+            np.stack(payloads),
+            max_new_tokens=o.get("max_new_tokens", 16),
+            temperature=o.get("temperature", 0.0),
+            seed=o.get("seed"))
+        return [out["tokens"][i] for i in range(len(payloads))]
+
+    return runner
+
+
+def generate_group_key(payload, opts):
+    """Decode requests batch together only when shape-compatible: same
+    prompt length (one prefill bucket) and same decode opts (one loop).
+
+    An explicitly-seeded request gets a UNIQUE group (batches alone):
+    sampling draws one noise tensor per batch, so co-batched rows — and
+    even the bucket size — would change a seeded request's tokens with
+    its batchmates. Solo it reproduces exactly (the engine pads a solo
+    row deterministically); the batching loss only hits requests that
+    opted into reproducibility."""
+    if opts.get("seed") is not None:
+        return object()  # equal only to itself
+    return (len(payload), opts.get("max_new_tokens", 16),
+            opts.get("temperature", 0.0))
+
+
+def predict_group_key(payload, opts):
+    """Predict requests batch together only when their example shapes
+    stack — one malformed request must fail alone, not 500 the whole
+    microbatch it landed in."""
+    del opts
+    return np.asarray(payload).shape
+
+
+class ServingMetrics:
+    """Cadenced scalar emission through MetricsLogger — the serving
+    counters land next to the training scalars. Installed as the
+    batchers' ``on_batch`` hook; also drives the optional profiler-trace
+    capture (``--serve_profile_batches``)."""
+
+    def __init__(self, logger, engine, *, emit_every: int = 50,
+                 profiler=None, name: str = ""):
+        self.logger = logger
+        self.engine = engine
+        self.emit_every = int(emit_every)
+        self.profiler = profiler
+        # one ServingMetrics per batcher: _t0/_last_count track ONE
+        # completed-counter; `name` keys the scalars per route so two
+        # batchers sharing a logger don't collide tag-for-tag
+        self.prefix = f"serve_{name}_" if name else "serve_"
+        self._t0 = time.monotonic()
+        self._last_count = 0
+        self._calls = 0
+        self._lock = threading.Lock()
+
+    def on_batch(self, batcher) -> None:
+        if self.profiler is not None:
+            self.profiler.on_batch()
+        if self.emit_every <= 0:  # 0 = scalars off (profiler still runs)
+            return
+        # cadence on OUR call count, not stats.batches: the hook only
+        # runs on success, and a failed batch on the modulo boundary
+        # would silently skip a whole emission window
+        with self._lock:
+            self._calls += 1
+            if self._calls % self.emit_every:
+                return
+        stats = batcher.stats.as_dict()
+        n = stats["batches"]
+        with self._lock:
+            dt = time.monotonic() - self._t0
+            done = stats["completed"]
+            rps = (done - self._last_count) / dt if dt > 0 else 0.0
+            self._t0 = time.monotonic()
+            self._last_count = done
+        p = self.prefix
+        scalars = {
+            f"{p}queue_depth": float(stats["queue_depth"]),
+            f"{p}throughput_rps": rps,
+            f"{p}rejected_full": float(stats["rejected_full"]),
+            f"{p}rejected_deadline": float(stats["rejected_deadline"]),
+            f"{p}reloads": float(self.engine.counters["reloads"]),
+            f"{p}reload_failures": float(
+                self.engine.counters["reload_failures"]),
+        }
+        if batcher.latency is not None:
+            scalars.update(batcher.latency.summary(f"{p}latency_ms_"))
+        if self.logger is not None:
+            self.logger.scalars(n, scalars)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dtt-serving/1.0"
+
+    def _send(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # quiet: metrics carry the story
+        pass
+
+    def do_GET(self):
+        srv: InferenceServer = self.server.serving  # type: ignore[attr-defined]
+        if self.path == "/healthz":
+            self._send(200, {"ok": True, "step": srv.engine.step})
+        elif self.path == "/stats":
+            self._send(200, srv.stats())
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        srv: InferenceServer = self.server.serving  # type: ignore[attr-defined]
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n) or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"bad JSON: {e}"})
+            return
+        try:
+            if self.path == "/v1/predict":
+                out = srv.client.predict(
+                    np.asarray(req["inputs"]),
+                    timeout_ms=req.get("timeout_ms"))
+                self._send(200, {"outputs": np.asarray(out).tolist()})
+            elif self.path == "/v1/generate":
+                toks = srv.client.generate(
+                    req["prompt"],
+                    max_new_tokens=req.get("max_new_tokens"),
+                    temperature=req.get("temperature"),
+                    seed=req.get("seed"),
+                    timeout_ms=req.get("timeout_ms"))
+                self._send(200, {"tokens": np.asarray(toks).tolist()})
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+        except RejectedError as e:
+            self._send(429, {"error": e.reason, "rejected": True})
+        except (KeyError, ValueError) as e:
+            self._send(400, {"error": f"{type(e).__name__}: {e}"})
+        except TimeoutError:
+            self._send(504, {"error": "request timed out in flight"})
+        except Exception as e:  # noqa: BLE001 — the wire must answer
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+class InferenceServer:
+    """ThreadingHTTPServer wrapper owning the route -> batcher wiring."""
+
+    def __init__(self, engine, client: InProcessClient,
+                 host: str = "127.0.0.1", port: int = 8000):
+        self.engine = engine
+        self.client = client
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.serving = self  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        h, p = self.httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def stats(self) -> dict:
+        out = {"engine": self.engine.stats()}
+        for name in ("predict_batcher", "generate_batcher"):
+            b = getattr(self.client, name)
+            if b is not None:
+                out[name] = b.stats.as_dict()
+                if b.latency is not None:
+                    out[name].update(b.latency.summary("latency_ms_"))
+        return out
+
+    def start_background(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="serve-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self.httpd.serve_forever()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -65,6 +65,16 @@ INJECTION_POINTS: dict[str, str] = {
             "cluster.maybe_initialize_distributed [ctx: attempt]",
     "prefetch": "in prefetch_to_device's staging thread, once per batch "
                 "[ctx: count]",
+    "serve_admit": "in serving.DynamicBatcher.submit after the admission "
+                   "checks pass, before the request enqueues "
+                   "[ctx: count]",
+    "serve_batch": "in the serving batcher worker after a microbatch is "
+                   "assembled, before the engine runs it "
+                   "[ctx: count, size]",
+    "serve_reload": "in serving.InferenceEngine.reload_if_newer before "
+                    "the fallback-ladder restore of a newer checkpoint "
+                    "(file modes corrupt that newest set) "
+                    "[ctx: path, step]",
 }
 
 MODES = ("crash", "error", "refuse", "torn_file", "zero_file", "bitflip",

@@ -10,7 +10,9 @@ in BOTH a JSONL file (any plotting tool) and a TensorBoard event file
 from __future__ import annotations
 
 import json
+import math
 import os
+import threading
 import time
 
 from distributed_tensorflow_tpu.utils.events import EventFileWriter
@@ -65,3 +67,107 @@ class MetricsLogger:
         if self._events is not None:
             self._events.close()
             self._events = None
+
+
+class StreamingHistogram:
+    """Streaming quantile estimator over geometric buckets (p50/p90/p99).
+
+    The serving path needs latency QUANTILES, not means — a p99 cannot be
+    recovered from scalar averages after the fact — but must not hold
+    every observation (heavy traffic = millions of samples). Values land
+    in geometrically-spaced buckets (``growth`` relative width per
+    bucket, so the quantile error is bounded by the bucket ratio, ~4%
+    at the default), quantiles read the bucket CDF with log-linear
+    interpolation inside the landing bucket. O(1) record, O(buckets)
+    quantile, fixed memory. Thread-safe: server handler threads record
+    while the metrics cadence reads.
+
+    ``summary(prefix)`` returns the p50/p90/p99/mean/count dict shaped
+    for ``MetricsLogger.scalars`` — serving latency lands in the same
+    JSONL + TensorBoard event sinks as the training scalars.
+    """
+
+    QUANTILES = (0.5, 0.9, 0.99)
+
+    def __init__(self, low: float = 1e-3, high: float = 1e7,
+                 growth: float = 1.08):
+        if not (0 < low < high) or growth <= 1.0:
+            raise ValueError(f"need 0 < low < high and growth > 1, got "
+                             f"low={low}, high={high}, growth={growth}")
+        self._low = float(low)
+        self._log_growth = math.log(growth)
+        n = int(math.ceil(math.log(high / low) / self._log_growth))
+        # bucket i spans [low*g^i, low*g^(i+1)); +2 for underflow/overflow
+        self._counts = [0] * (n + 2)
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def _bucket(self, value: float) -> int:
+        if value < self._low:
+            return 0
+        i = int(math.log(value / self._low) / self._log_growth) + 1
+        return min(i, len(self._counts) - 1)
+
+    def record(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._counts[self._bucket(value)] += 1
+            self._count += 1
+            self._sum += value
+            self._min = min(self._min, value)
+            self._max = max(self._max, value)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._count if self._count else 0.0
+
+    def _edge(self, i: int) -> float:
+        """Lower edge of bucket ``i`` (i >= 1; bucket 0 is underflow)."""
+        return self._low * math.exp((i - 1) * self._log_growth)
+
+    def quantile(self, q: float) -> float:
+        """Value at quantile ``q`` in [0, 1]; 0.0 when empty. Clamped to
+        the observed min/max so sparse histograms don't over-report the
+        bucket width."""
+        with self._lock:
+            if not self._count:
+                return 0.0
+            rank = q * self._count
+            seen = 0.0
+            for i, c in enumerate(self._counts):
+                if not c:
+                    continue
+                if seen + c >= rank:
+                    if i == 0:
+                        return self._min
+                    frac = min(max((rank - seen) / c, 0.0), 1.0)
+                    lo = self._edge(i)
+                    val = lo * math.exp(frac * self._log_growth)
+                    return min(max(val, self._min), self._max)
+                seen += c
+            return self._max
+
+    def summary(self, prefix: str = "") -> dict:
+        """{prefix}p50/p90/p99/mean/count — the scalars dict the serving
+        metrics cadence hands to MetricsLogger/events."""
+        out = {f"{prefix}p{int(q * 100)}": self.quantile(q)
+               for q in self.QUANTILES}
+        out[f"{prefix}mean"] = self.mean
+        out[f"{prefix}count"] = float(self._count)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * len(self._counts)
+            self._count = 0
+            self._sum = 0.0
+            self._min = math.inf
+            self._max = -math.inf

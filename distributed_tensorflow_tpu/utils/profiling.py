@@ -8,6 +8,7 @@ by the training loop via ``--profile_dir`` (training/loop.py).
 
 from __future__ import annotations
 
+import threading
 import time
 
 import jax
@@ -36,6 +37,65 @@ class Throughput:
     @property
     def images_per_sec_per_chip(self) -> float:
         return self.images_per_sec / max(self.n_chips, 1)
+
+
+class ServeTraceCapture:
+    """``--serve_profile_batches N``: capture ONE jax.profiler trace
+    window around N served microbatches and report the artifact path.
+
+    Installed as the serving metrics hook's profiler: the first
+    ``on_batch`` call starts the trace, the Nth stops it — so the window
+    brackets real traffic (steady-state batching, reload blips included
+    if one lands inside), not a synthetic loop. One-shot by design: a
+    profile is an investigation artifact, not a steady-state cost.
+    ``path`` (and the returned value of the closing ``on_batch``) is the
+    trace directory for ``tensorboard --logdir`` / Perfetto."""
+
+    def __init__(self, profile_dir: str, n_batches: int):
+        if n_batches < 1:
+            raise ValueError(f"n_batches must be >= 1, got {n_batches}")
+        self.profile_dir = profile_dir
+        self.n_batches = int(n_batches)
+        self._seen = 0
+        self._active = False
+        self._done = False
+        # shared across every batcher's worker thread: start/stop of the
+        # singleton jax profiler must be check-then-act under one lock
+        self._lock = threading.Lock()
+        self.path: str | None = None
+
+    def on_batch(self) -> str | None:
+        """Call once per served microbatch (any worker thread). Returns
+        the artifact path on the call that closes the window, else
+        None."""
+        with self._lock:
+            if self._done:
+                return None
+            if not self._active:
+                import os
+
+                os.makedirs(self.profile_dir, exist_ok=True)
+                jax.profiler.start_trace(self.profile_dir)
+                self._active = True
+            self._seen += 1
+            if self._seen >= self.n_batches:
+                jax.profiler.stop_trace()
+                self._active = False
+                self._done = True
+                self.path = self.profile_dir
+                print(f"serving profile: traced {self._seen} batches "
+                      f"into {self.profile_dir}")
+                return self.path
+            return None
+
+    def close(self) -> None:
+        """Stop a still-open window (server shutdown before N batches)."""
+        with self._lock:
+            if self._active:
+                jax.profiler.stop_trace()
+                self._active = False
+                self._done = True
+                self.path = self.profile_dir
 
 
 def collective_sync_cadence(multi_device: bool) -> int:

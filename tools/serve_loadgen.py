@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Load generator for the serving stack — open- and closed-loop.
+
+Closed loop (``run_closed_loop``): N workers each keep exactly one
+request in flight — measures the system at its natural concurrency
+(latency under a fixed multiprogramming level). Open loop
+(``run_open_loop``): requests FIRE at a target rate whatever the
+responses do — the honest way to measure tail latency under offered
+load, since a closed loop's arrival process slows down with the server
+and hides queueing collapse. Both return the same report dict
+(p50/p90/p99 latency ms, achieved rps, ok/rejected/error counts), both
+drive either the in-process client or a JSON-over-HTTP endpoint.
+
+CLI (HTTP mode):
+
+    python tools/serve_loadgen.py --url http://127.0.0.1:8000 \
+        --mode open --rate 200 --duration 10 --kind generate \
+        --prompt_len 8 --max_new_tokens 16
+
+bench.py's serving phase imports the loop runners directly against an
+in-process client (no sockets on the timed path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+# sys.path[0] is tools/ when run as a script; the package root is one up
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from distributed_tensorflow_tpu.serving.batcher import RejectedError
+from distributed_tensorflow_tpu.utils.metrics import StreamingHistogram
+
+
+def _report(hist: StreamingHistogram, ok: int, rejected: int,
+            errors: int, elapsed_s: float) -> dict:
+    out = dict(hist.summary("latency_ms_"))
+    out.update({
+        "ok": ok,
+        "rejected": rejected,
+        "errors": errors,
+        "elapsed_s": round(elapsed_s, 3),
+        "achieved_rps": round(ok / elapsed_s, 2) if elapsed_s > 0 else 0.0,
+    })
+    return out
+
+
+class _Counters:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.ok = 0
+        self.rejected = 0
+        self.errors = 0
+
+    def add(self, kind: str):
+        with self.lock:
+            setattr(self, kind, getattr(self, kind) + 1)
+
+
+def _call_and_record(request_fn, hist: StreamingHistogram,
+                     c: _Counters) -> None:
+    t0 = time.monotonic()
+    try:
+        request_fn()
+        hist.record((time.monotonic() - t0) * 1e3)
+        c.add("ok")
+    except RejectedError:
+        c.add("rejected")
+    except Exception:  # noqa: BLE001 — the loadgen reports, not raises
+        c.add("errors")
+
+
+def run_closed_loop(request_fn, *, n_requests: int = 200,
+                    concurrency: int = 4) -> dict:
+    """``concurrency`` workers, one request in flight each, until
+    ``n_requests`` total have been attempted."""
+    hist = StreamingHistogram()
+    c = _Counters()
+    issued = [0]
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                if issued[0] >= n_requests:
+                    return
+                issued[0] += 1
+            _call_and_record(request_fn, hist, c)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return _report(hist, c.ok, c.rejected, c.errors,
+                   time.monotonic() - t0)
+
+
+def run_open_loop(request_fn, *, rate_rps: float, duration_s: float,
+                  max_inflight: int = 256) -> dict:
+    """Fire at ``rate_rps`` (uniform arrivals) for ``duration_s``; each
+    request runs on its own thread so a slow server cannot throttle the
+    arrival process (that's the point of open loop). ``max_inflight``
+    bounds the thread population — beyond it arrivals count as errors
+    (client saturation, reported, not hidden)."""
+    hist = StreamingHistogram()
+    c = _Counters()
+    inflight = threading.Semaphore(max_inflight)
+    threads: list[threading.Thread] = []
+    interval = 1.0 / rate_rps
+    t0 = time.monotonic()
+    next_fire = t0
+
+    def one():
+        try:
+            _call_and_record(request_fn, hist, c)
+        finally:
+            inflight.release()
+
+    while time.monotonic() - t0 < duration_s:
+        now = time.monotonic()
+        if now < next_fire:
+            time.sleep(next_fire - now)
+        next_fire += interval
+        if not inflight.acquire(blocking=False):
+            c.add("errors")
+            continue
+        th = threading.Thread(target=one, daemon=True)
+        th.start()
+        threads.append(th)
+    # throughput is ok/OFFERED-window: folding the post-window drain
+    # (joins below, up to 30 s under backlog) into the denominator would
+    # deflate achieved_rps exactly when the server is saturated — the
+    # condition the open loop exists to measure honestly
+    t_offered = time.monotonic() - t0
+    for th in threads:
+        th.join(timeout=30)
+    out = _report(hist, c.ok, c.rejected, c.errors, t_offered)
+    out["drain_s"] = round(time.monotonic() - t0 - t_offered, 3)
+    out["offered_rps"] = rate_rps
+    return out
+
+
+def http_request_fn(url: str, kind: str, *, prompt_len: int = 8,
+                    vocab_size: int = 64, input_dim: int = 784,
+                    max_new_tokens: int = 16):
+    """A request closure against the HTTP front end. Raises
+    ``RejectedError`` on 429 so backpressure is counted, not miscounted
+    as an error."""
+
+    if kind == "generate":
+        body = json.dumps({
+            "prompt": [i % vocab_size for i in range(prompt_len)],
+            "max_new_tokens": max_new_tokens}).encode()
+        path = "/v1/generate"
+    else:
+        body = json.dumps(
+            {"inputs": [0.5] * input_dim}).encode()
+        path = "/v1/predict"
+
+    def call():
+        req = urllib.request.Request(
+            url.rstrip("/") + path, data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                raise RejectedError(f"HTTP 429: {e.read()[:200]}") from e
+            raise
+
+    return call
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--url", required=True,
+                    help="serving endpoint, e.g. http://127.0.0.1:8000")
+    ap.add_argument("--mode", choices=("open", "closed"), default="closed")
+    ap.add_argument("--kind", choices=("predict", "generate"),
+                    default="predict")
+    ap.add_argument("--requests", type=int, default=200,
+                    help="closed loop: total requests")
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="closed loop: in-flight requests")
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="open loop: offered requests/sec")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="open loop: seconds")
+    ap.add_argument("--prompt_len", type=int, default=8)
+    ap.add_argument("--vocab_size", type=int, default=64)
+    ap.add_argument("--input_dim", type=int, default=784)
+    ap.add_argument("--max_new_tokens", type=int, default=16)
+    args = ap.parse_args()
+
+    fn = http_request_fn(args.url, args.kind, prompt_len=args.prompt_len,
+                         vocab_size=args.vocab_size,
+                         input_dim=args.input_dim,
+                         max_new_tokens=args.max_new_tokens)
+    if args.mode == "closed":
+        rep = run_closed_loop(fn, n_requests=args.requests,
+                              concurrency=args.concurrency)
+    else:
+        rep = run_open_loop(fn, rate_rps=args.rate,
+                            duration_s=args.duration)
+    print(json.dumps(rep))
+
+
+if __name__ == "__main__":
+    main()
