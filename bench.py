@@ -893,6 +893,143 @@ def serving_phase() -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+# r10: the dp_zero phase A/Bs replicated sync DP against --zero 1
+# (ZeRO optimizer-state sharding, parallel/zero.py) on the flagship CNN
+# in the same session — identical math (bit-identical trajectories,
+# tests/test_zero.py), D-fold less optimizer HBM per chip. The memory
+# facts are ANALYTIC (jax.eval_shape, host-only) so they stay non-null
+# in EVERY record including the degraded/outage one; the A/B rates and
+# the measured live-buffer bytes need the chip.
+ZERO_TIMED_CHUNKS = 4
+
+
+def _zero_mem_facts(d: int) -> dict:
+    """Analytic per-chip ZeRO memory/comm facts for the flagship CNN
+    (zero_memory_budget — no chip, no compute). ``d`` clamps to 2 so
+    the 1-chip/outage record still shows the 2-way fallback config the
+    other analytic facts use."""
+    from distributed_tensorflow_tpu.models import DeepCNN
+    from distributed_tensorflow_tpu.parallel.zero import zero_memory_budget
+    from distributed_tensorflow_tpu.training import adam
+
+    try:
+        d = max(2, int(d))
+        b = zero_memory_budget(DeepCNN(compute_dtype=jnp.bfloat16),
+                               adam(1e-3), d)
+        per = b["per_chip"]
+        total = lambda k: sum(per[k].values())
+        g = b["param_bytes"]
+        return {
+            "zero_data_ways": d,
+            "zero_opt_bytes_per_chip": per["zero1"]["opt"],
+            "zero_opt_bytes_per_chip_replicated": per["replicated"]["opt"],
+            "zero_opt_reduction": round(b["opt_reduction"], 3),
+            "zero3_param_bytes_per_chip": per["zero3"]["params"],
+            "zero_param_reduction": round(b["param_reduction"], 3),
+            "zero_total_bytes_per_chip_analytic": total("zero1"),
+            "dp_total_bytes_per_chip_analytic": total("replicated"),
+            "zero_comm_bytes_allreduce": 2 * g,
+            "zero_comm_bytes_reduce_scatter_gather": g + b["param_bytes"],
+        }
+    except Exception as e:  # never kill the record over the accounting
+        return {"zero_data_ways": None,
+                "zero_opt_bytes_per_chip": None,
+                "zero_opt_bytes_per_chip_replicated": None,
+                "zero_opt_reduction": None,
+                "zero3_param_bytes_per_chip": None,
+                "zero_param_reduction": None,
+                "zero_total_bytes_per_chip_analytic": None,
+                "dp_total_bytes_per_chip_analytic": None,
+                "zero_comm_bytes_allreduce": None,
+                "zero_comm_bytes_reduce_scatter_gather": None,
+                "zero_mem_error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _live_bytes_per_chip():
+    """Mean live-buffer bytes per local device via device.memory_stats()
+    — None where the backend doesn't report (CPU)."""
+    try:
+        stats = [dev.memory_stats() for dev in jax.local_devices()]
+        vals = [s["bytes_in_use"] for s in stats
+                if s and "bytes_in_use" in s]
+        return int(sum(vals) / len(vals)) if vals else None
+    except Exception:  # noqa: BLE001 — absence of the stat, not an error
+        return None
+
+
+def dp_zero_phase(ds, n_chips) -> dict:
+    """Same-session A/B: replicated sync DP vs --zero 1 on the flagship
+    CNN over the device-resident input path (identical sampling — the
+    trajectories are bit-identical, so the A/B isolates the collective
+    pattern + memory layout). Records the measured rates and live-buffer
+    bytes where the backend reports them (``device.memory_stats()``;
+    analytic totals stand in where it doesn't, ``zero_live_bytes_source``
+    says which), on top of the always-recorded analytic facts."""
+    out = _zero_mem_facts(n_chips)
+    out.update({
+        "dp_ab_images_per_sec_per_chip": None,
+        "zero_images_per_sec_per_chip": None,
+        "zero_live_bytes_per_chip": out["zero_total_bytes_per_chip_analytic"],
+        "dp_live_bytes_per_chip": out["dp_total_bytes_per_chip_analytic"],
+        "zero_live_bytes_source": "analytic",
+    })
+    if n_chips < 2:
+        out["zero_skipped"] = "needs a >1-chip data axis"
+        return out
+
+    from distributed_tensorflow_tpu.data.device_data import put_device_data
+    from distributed_tensorflow_tpu.models import DeepCNN
+    from distributed_tensorflow_tpu.parallel import make_mesh
+    from distributed_tensorflow_tpu.parallel.data_parallel import (
+        replicate_state,
+    )
+    from distributed_tensorflow_tpu.parallel.zero import shard_state_zero
+    from distributed_tensorflow_tpu.training import adam, create_train_state
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_device_dp_train_step,
+        make_zero_device_train_step,
+    )
+
+    model = DeepCNN(compute_dtype=jnp.bfloat16)
+    opt = adam(1e-3)
+    mesh = make_mesh()
+    batch_size = PER_CHIP_BATCH * n_chips
+    data = put_device_data(ds.train, mesh)
+    sync_every = _sync_every(n_chips)
+    rates = {}
+    live = {}
+    for name in ("replicated", "zero1"):
+        state = create_train_state(model, opt, seed=0)
+        if name == "replicated":
+            state = replicate_state(mesh, state)
+            chunk_fn = make_device_dp_train_step(
+                model, opt, mesh, batch_size, keep_prob=0.75, chunk=CHUNK)
+        else:
+            state = shard_state_zero(state, mesh, 1)
+            chunk_fn = make_zero_device_train_step(
+                model, opt, mesh, 1, batch_size, keep_prob=0.75,
+                chunk=CHUNK)
+        state, m = chunk_fn(state, data)  # compile + upload
+        float(m["loss"])  # hard readback so the clock starts clean
+        live[name] = _live_bytes_per_chip()
+        t0 = time.perf_counter()
+        for c in range(1, ZERO_TIMED_CHUNKS + 1):
+            state, m = chunk_fn(state, data)
+            if sync_every and (c * CHUNK) % sync_every < CHUNK:
+                jax.block_until_ready(state.params)
+        jax.block_until_ready(state.params)
+        dt = time.perf_counter() - t0
+        rates[name] = ZERO_TIMED_CHUNKS * CHUNK * batch_size / dt / n_chips
+        del state
+    out["dp_ab_images_per_sec_per_chip"] = round(rates["replicated"], 1)
+    out["zero_images_per_sec_per_chip"] = round(rates["zero1"], 1)
+    if live["zero1"] is not None and live["replicated"] is not None:
+        out.update({"zero_live_bytes_per_chip": live["zero1"],
+                    "dp_live_bytes_per_chip": live["replicated"],
+                    "zero_live_bytes_source": "memory_stats"})
+    return out
+
+
 def recovery_phase() -> dict:
     """Verified-restore drill (r8): save two checkpoints of a small host
     state, TEAR the newest mid-file (the machine-crash signature the
@@ -1073,6 +1210,18 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
     # here; `partial` overrides with the measured config when phases
     # ran before the flap)
     out.update(_pp_schedule_facts(2))
+    # the ZeRO memory/comm facts are analytic too (jax.eval_shape):
+    # the D-fold optimizer-state saving stays auditable through outages
+    # (2-way fallback config; the A/B rates need the chip and stay null)
+    zmem = _zero_mem_facts(2)
+    out.update(zmem)
+    out.update({"dp_ab_images_per_sec_per_chip": None,
+                "zero_images_per_sec_per_chip": None,
+                "zero_live_bytes_per_chip":
+                    zmem["zero_total_bytes_per_chip_analytic"],
+                "dp_live_bytes_per_chip":
+                    zmem["dp_total_bytes_per_chip_analytic"],
+                "zero_live_bytes_source": "analytic"})
     # the restore-ladder and serving drills are host-only: the
     # recovery_* and serving_* fields stay non-null in EVERY record,
     # outage or not
@@ -1175,6 +1324,9 @@ def _run_phases(out: dict):
     # over the device-resident input path (skipped fields on 1 chip)
     out.update(pp_device_phase(n_chips))
     out.update(ep_device_phase(n_chips))
+    # r10: ZeRO-sharded DP A/B — replicated vs --zero 1, flagship CNN,
+    # device-resident input (analytic memory facts + measured rates)
+    out.update(dp_zero_phase(ds, n_chips))
     # r8: the verified-restore drill (host-only; also runs in the
     # degraded record so the recovery fields are never null)
     out.update(recovery_phase())

@@ -12,8 +12,20 @@ from distributed_tensorflow_tpu.parallel.tensor_parallel import (
     make_tp_train_step,
     shard_state_tp,
 )
+from distributed_tensorflow_tpu.parallel.zero import (
+    fetch_state_zero,
+    make_zero_train_step,
+    shard_state_zero,
+    zero_clip_transform,
+    zero_memory_budget,
+)
 
 __all__ = [
+    "fetch_state_zero",
+    "make_zero_train_step",
+    "shard_state_zero",
+    "zero_clip_transform",
+    "zero_memory_budget",
     "MeshSpec",
     "make_mesh",
     "batch_sharding",

@@ -136,5 +136,27 @@ def make_dp_eval_step(model, mesh):
 
 
 def replicate_state(mesh, state: TrainState) -> TrainState:
-    """Place a host-built TrainState replicated over the mesh."""
+    """Place a host-built TrainState replicated over the mesh.
+
+    Refuses a state whose leaves are ALREADY device-sharded (a ZeRO /
+    PP / TP layout from a prior placement): silently re-replicating
+    would bake the sharded representation — for ZeRO, flat PADDED
+    chunk vectors — onto every device as if it were the standard
+    layout, and training would consume garbage. Fetch the standard
+    layout first (``parallel.zero.fetch_state_zero`` /
+    ``fetch_state_pp``) and replicate that."""
+    from distributed_tensorflow_tpu.utils.pytree import path_key
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if (isinstance(leaf, jax.Array)
+                and len(leaf.sharding.device_set) > 1
+                and not leaf.is_fully_replicated):
+            raise ValueError(
+                f"replicate_state: leaf {path_key(path)!r} is already "
+                f"sharded over {len(leaf.sharding.device_set)} devices "
+                f"(a ZeRO/PP/TP placement) — re-replicating would "
+                f"silently treat the sharded (padded) layout as the "
+                f"standard one. Fetch the standard layout first "
+                f"(e.g. parallel.zero.fetch_state_zero) and replicate "
+                f"that.")
     return jax.device_put(state, replicated_sharding(mesh))

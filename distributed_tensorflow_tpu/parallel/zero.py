@@ -1,0 +1,459 @@
+"""ZeRO-sharded data parallelism: shard the redundant state over "data".
+
+Plain sync DP (``data_parallel.py``) replicates params AND the full
+optimizer state (Adam's ``m``/``v``, momentum's velocity) on every chip
+and pays one full-gradient all-reduce per step. ZeRO (Rajbhandari et
+al., 2020) observes that under *synchronous* DP the replicated optimizer
+state is pure waste: every replica computes the identical update, so the
+state can be PARTITIONED 1/D per data-parallel rank at identical math.
+PyTorch FSDP (Zhao et al., 2023) extends the same partitioning to the
+parameters themselves. This module implements both on the existing
+``shard_map`` style where the collectives stay explicit in the program:
+
+``--zero 1`` (optimizer-state sharding)
+    Gradients leave the backward pass as full local leaves, are
+    flattened, zero-padded to a multiple of D, and ``lax.psum_scatter``
+    over the data axis — each rank receives its 1/D chunk of the
+    SUMMED gradient (one reduce-scatter, |G| bytes on the wire instead
+    of the all-reduce's 2|G|). The optimizer update then runs on each
+    rank's 1/D chunk of the opt state against its 1/D chunk of the
+    (replicated) params, and ONE ``all_gather`` (|P| bytes) rebuilds
+    the full updated params everywhere. Per-step comm: |G| + |P| vs
+    the all-reduce's 2|G|; per-chip optimizer memory: 1/D.
+
+``--zero 3`` (FSDP-style: params sharded too)
+    Params themselves LIVE as 1/D flat chunks and are all-gathered
+    inside the forward (the gather is wrapped in ``jax.checkpoint`` so
+    the backward re-gathers instead of keeping a second full copy —
+    the "free remat of the gather"). No hand-written reduce-scatter is
+    needed: differentiating through ``all_gather`` IS the
+    reduce-scatter — its transpose routes each rank's gradient
+    contributions straight into the owning rank's chunk, bitwise equal
+    to the explicit ``psum_scatter`` (pinned by tests). Per-chip
+    params at rest: 1/D (the step transiently materializes one full
+    copy for the forward/backward, same as replicated compute needs).
+
+Exactness: the arithmetic is IDENTICAL to replicated sync DP — on this
+backend ``psum_scatter`` chunks bit-match the ``psum`` they partition
+(both reduce contributions in the same rank order), every optimizer op
+is elementwise, and padding lanes are inert under sgd/momentum/adam
+(zero grads beget zero updates) — so unclipped trajectories are
+BIT-IDENTICAL to ``make_dp_train_step`` step-for-step, dropout and
+``accum_steps`` included (tests/test_zero.py). ``--clip_norm`` needs
+the ZeRO-aware transform (``zero_clip_transform``): every grad leaf
+inside the step is a distinct shard, so the squared-norm partials must
+``psum`` over the data axis before ONE scale applies everywhere — the
+same replicated-leaf-divergence class of bug the PP/EP clips fixed.
+The psum'd partial assembly can differ from the replicated clip's
+full-leaf reduction in the last ulp (float addition is not
+associative), so clipped trajectories match replicated DP to float
+tolerance while staying bit-identical ACROSS ZeRO levels and across
+replicas.
+
+Checkpoints stay STANDARD-LAYOUT (the PP stacking machinery's
+contract): ``shard_state_zero``/``fetch_state_zero`` convert between
+the flat-chunk device layout and the ordinary pytree, so a ``--zero``
+run restores a replicated checkpoint and vice versa, bitwise, through
+the verified-restore fallback ladder; serving's params-only restore is
+untouched.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS
+from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+    _map_params_shaped,
+)
+from distributed_tensorflow_tpu.training.train_state import (
+    TrainState,
+    apply_augment,
+    apply_updates,
+    compute_grads,
+    create_train_state,
+    loss_and_metrics,
+)
+
+def _leaf_size(sds) -> int:
+    """Element count of a (possibly scalar) leaf."""
+    return math.prod(sds.shape) if sds.shape else 1
+
+
+def abstract_params(model):
+    """ShapeDtypeStruct tree of the model's params — the per-leaf
+    (shape, dtype) metadata every gather/scatter needs to undo the flat
+    padded chunking. ``jax.eval_shape`` so no compute and no chip."""
+    variables = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    if getattr(model, "stateful", False):
+        return variables["params"]
+    return variables
+
+
+def _gather_leaf(chunk, sds):
+    """Local 1/D chunk -> the full leaf: tiled all_gather over the data
+    axis, drop the padding lanes, restore the original shape."""
+    n = _leaf_size(sds)
+    full = lax.all_gather(chunk, DATA_AXIS, tiled=True)
+    return full[:n].reshape(sds.shape)
+
+
+def _gather_params(chunks, meta):
+    return jax.tree.map(_gather_leaf, chunks, meta)
+
+
+def _scatter_leaf(g):
+    """Full local leaf -> this rank's 1/D chunk of the cross-rank SUM:
+    flatten, zero-pad to a multiple of the axis size, psum_scatter. The
+    padding lanes reduce exact zeros, so they stay inert through every
+    optimizer."""
+    d = lax.axis_size(DATA_AXIS)
+    flat = g.reshape(-1)
+    pad = (-flat.size) % d
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return lax.psum_scatter(flat, DATA_AXIS, scatter_dimension=0,
+                            tiled=True)
+
+
+def _local_chunk(x):
+    """This rank's 1/D flat chunk of a REPLICATED full leaf (the ZeRO-1
+    param slice the optimizer updates): pad, then slice at the rank's
+    offset — bit-identical to the chunk a psum_scatter would own."""
+    d = lax.axis_size(DATA_AXIS)
+    flat = x.reshape(-1)
+    pad = (-flat.size) % d
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    c = flat.shape[0] // d
+    return lax.dynamic_slice_in_dim(flat, lax.axis_index(DATA_AXIS) * c, c)
+
+
+def zero_clip_transform(max_norm: float):
+    """Axis-correct global-norm clip for INSIDE a ZeRO ``shard_map``
+    step. Every grad leaf the transform sees is a DISTINCT 1/D shard of
+    the mean gradient, so each rank's local squared sum is an exact
+    partial of the global squared norm; one ``psum`` over the data axis
+    totals them and the SAME scale applies on every rank — replicated
+    params (ZeRO-1's all-gathered update) stay bit-identical across
+    replicas, and the clipped trajectory is bit-identical across ZeRO
+    levels. (A plain ``clip_by_global_norm`` here would scale by a
+    rank-LOCAL norm — the divergence class PR 1 fixed for PP/EP.) This
+    is ``clip_by_global_norm(axis=DATA_AXIS, sharded_leaf=always)``
+    specialized: kept as its own named transform because the ZeRO step
+    is the one place every leaf is guaranteed sharded."""
+    from distributed_tensorflow_tpu.training.train_state import (
+        clip_by_global_norm,
+    )
+
+    return clip_by_global_norm(max_norm, axis=DATA_AXIS,
+                               sharded_leaf=lambda path: True)
+
+
+def zero_state_specs(state: TrainState, level: int) -> TrainState:
+    """PartitionSpec pytree for a ZeRO-layout TrainState — the one place
+    the chunked-over-"data" rule is written (shard_map specs and device
+    shardings both derive from it). Works on either layout (the flat
+    chunking preserves tree structure): params-shaped optimizer subtrees
+    are chunked, scalar slots (adam's ``t``) replicate; params chunk
+    only at level 3."""
+    level = _check_level(level)
+    pstruct = jax.tree.structure(state.params)
+    chunked = lambda sub: jax.tree.map(lambda _: P(DATA_AXIS), sub)
+    replicated = lambda sub: jax.tree.map(lambda _: P(), sub)
+    return TrainState(
+        params=(chunked if level >= 3 else replicated)(state.params),
+        opt_state=_map_params_shaped(state.opt_state, pstruct, chunked,
+                                     replicated),
+        step=P(), rng=P(),
+        model_state=replicated(state.model_state))
+
+
+def zero_state_sharding(state: TrainState, mesh, level: int) -> TrainState:
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                        zero_state_specs(state, level),
+                        is_leaf=lambda v: isinstance(v, P))
+
+
+def _check_level(level: int) -> int:
+    level = int(level)
+    if level not in (1, 3):
+        raise ValueError(f"zero level must be 1 (optimizer-state "
+                         f"sharding) or 3 (params too); got {level}")
+    return level
+
+
+def shard_state_zero(state: TrainState, mesh, level: int) -> TrainState:
+    """Standard-layout (host) TrainState -> the ZeRO device layout:
+    params-shaped optimizer subtrees (and, at level 3, the params)
+    become flat zero-padded vectors of global length D*ceil(n/D),
+    sharded 1/D per rank over the data axis; everything else replicates.
+    The inverse is ``fetch_state_zero`` — checkpoints only ever see the
+    standard layout."""
+    level = _check_level(level)
+    d = mesh.shape[DATA_AXIS]
+
+    def chunk_host(x):
+        a = np.asarray(x)
+        flat = a.reshape(-1)
+        pad = (-flat.size) % d
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, dtype=a.dtype)])
+        return flat
+
+    chunkify = lambda tree: jax.tree.map(chunk_host, tree)
+    pstruct = jax.tree.structure(state.params)
+    out = state._replace(
+        params=chunkify(state.params) if level >= 3 else state.params,
+        opt_state=_map_params_shaped(state.opt_state, pstruct, chunkify,
+                                     lambda e: e))
+    return jax.device_put(out, zero_state_sharding(out, mesh, level))
+
+
+def fetch_state_zero(state: TrainState, model, level: int) -> TrainState:
+    """ZeRO-layout state -> host state in the STANDARD layout (the
+    checkpoint format): undo the flat padded chunking on the params (at
+    level 3) and on every params-shaped optimizer subtree — so
+    checkpoints are identical whatever ``--zero`` level (or none) the
+    run trained under."""
+    level = _check_level(level)
+    host = jax.device_get(state)
+    meta = abstract_params(model)
+
+    def unchunk_leaf(flat, sds):
+        n = _leaf_size(sds)
+        return np.asarray(flat)[:n].reshape(sds.shape)
+
+    unchunk = lambda tree: jax.tree.map(unchunk_leaf, tree, meta)
+    pstruct = jax.tree.structure(host.params)
+    return host._replace(
+        params=unchunk(host.params) if level >= 3 else host.params,
+        opt_state=_map_params_shaped(host.opt_state, pstruct, unchunk,
+                                     lambda e: e))
+
+
+def _zero_step_core(model, optimizer, mesh, level, keep_prob,
+                    grad_transform, accum_steps: int = 1):
+    """The per-shard ZeRO step body shared by the host-fed builder and
+    the device-resident sampler (``device_step.make_zero_device_train_
+    step``): ``core(state, batch, sub, rng) -> (state, metrics)`` for
+    inside ``shard_map``. The caller owns the rng-split/augment/sample
+    derivations (they must bit-match its replicated twin's); the core
+    owns grads -> reduce-scatter -> clip -> sharded update -> gather."""
+    level = _check_level(level)
+    d = mesh.shape[DATA_AXIS]
+    meta = abstract_params(model)
+
+    def core(state: TrainState, batch, sub, rng):
+        if level >= 3:
+            if accum_steps <= 1:
+                # grads w.r.t. the CHUNKS through a remat'd gather: the
+                # all_gather transpose IS the reduce-scatter (bitwise
+                # equal to the explicit psum_scatter — tests pin it),
+                # and jax.checkpoint re-gathers in the backward instead
+                # of keeping a second full param copy alive
+                gathered = jax.checkpoint(
+                    lambda ch: _gather_params(ch, meta))
+
+                def loss_fn(pchunks):
+                    return loss_and_metrics(
+                        model, gathered(pchunks), batch,
+                        keep_prob=keep_prob, rng=sub, train=True,
+                        model_state=state.model_state)
+
+                gsum, aux = jax.grad(loss_fn, has_aux=True)(state.params)
+                gchunks = jax.tree.map(lambda g: g / d, gsum)
+                metrics = aux["metrics"]
+                model_state = aux["model_state"]
+            else:
+                # accumulation: gather ONCE per step (not per
+                # microbatch), accumulate full local grads exactly as
+                # the replicated step does, then one reduce-scatter —
+                # the same reduction order, so trajectories stay
+                # bit-identical to replicated accumulation
+                full = _gather_params(state.params, meta)
+                grads, metrics, model_state = compute_grads(
+                    model, full, batch, keep_prob=keep_prob, rng=sub,
+                    model_state=state.model_state,
+                    accum_steps=accum_steps)
+                gchunks = jax.tree.map(lambda g: _scatter_leaf(g) / d,
+                                       grads)
+            pchunks = state.params
+        else:
+            grads, metrics, model_state = compute_grads(
+                model, state.params, batch, keep_prob=keep_prob, rng=sub,
+                model_state=state.model_state, accum_steps=accum_steps)
+            # reduce-scatter (|G| on the wire) where the replicated step
+            # all-reduces (2|G|); /d after, matching pmean's psum-then-
+            # divide bit-for-bit
+            gchunks = jax.tree.map(lambda g: _scatter_leaf(g) / d, grads)
+            pchunks = jax.tree.map(_local_chunk, state.params)
+        if grad_transform is not None:
+            gchunks = grad_transform(gchunks)
+        metrics = lax.pmean(metrics, DATA_AXIS)
+        if model_state:
+            model_state = lax.pmean(model_state, DATA_AXIS)
+        # every optimizer op is elementwise over (grads, slots, params),
+        # so running it on 1/D chunks computes bit-identical values to
+        # the replicated full-leaf update — on 1/D the memory and FLOPs
+        updates, opt_state = optimizer.update(gchunks, state.opt_state,
+                                              pchunks, state.step)
+        pchunks = apply_updates(pchunks, updates)
+        if level >= 3:
+            params = pchunks  # stays sharded; the next step re-gathers
+        else:
+            # ONE all_gather (|P|) rebuilds the replicated params
+            params = _gather_params(pchunks, meta)
+        return TrainState(params, opt_state, state.step + 1, rng,
+                          model_state), metrics
+
+    return core
+
+
+def make_zero_train_step(model, optimizer, mesh, level: int,
+                         keep_prob: float = 1.0, donate: bool = True,
+                         grad_transform=None, accum_steps: int = 1,
+                         augment_fn=None):
+    """Compiled ZeRO-sharded sync-DP train step: (ZeRO-layout state,
+    sharded batch) -> (state, metrics). Drop-in for
+    ``make_dp_train_step`` on a state placed by ``shard_state_zero``;
+    unclipped trajectories are BIT-IDENTICAL to it (same rng folds,
+    same augment stream, same elementwise update arithmetic — only the
+    collective pattern changes). ``grad_transform`` runs on the
+    SCATTERED mean-grad chunks — pass ``zero_clip_transform`` for an
+    axis-correct ``--clip_norm``."""
+    core = _zero_step_core(model, optimizer, mesh, level, keep_prob,
+                           grad_transform, accum_steps)
+
+    def per_shard(state: TrainState, batch):
+        rng, sub = jax.random.split(state.rng)
+        # identical key evolution to make_dp_train_step's per_shard
+        sub = jax.random.fold_in(sub, lax.axis_index(DATA_AXIS))
+        batch = apply_augment(augment_fn, batch, state.rng,
+                              shard_index=lax.axis_index(DATA_AXIS))
+        return core(state, batch, sub, rng)
+
+    batch_spec = (P(DATA_AXIS), P(DATA_AXIS))
+    cache: dict = {}
+
+    def call(state, batch):
+        fn = cache.get("fn")
+        if fn is None:
+            specs = zero_state_specs(state, level)
+            sharded = jax.shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(specs, batch_spec),
+                out_specs=(specs, P()),
+                check_vma=False)
+            fn = cache["fn"] = jax.jit(
+                sharded, donate_argnums=(0,) if donate else ())
+        return fn(state, batch)
+
+    return call
+
+
+def make_zero_eval_step(model, mesh, level: int):
+    """Sharded full-batch eval for a ZeRO-layout state. Level 1 params
+    are replicated, so the plain DP eval step applies verbatim; level 3
+    all-gathers the param chunks inside ``shard_map`` first (identical
+    reconstruction, so metrics bit-match the DP eval)."""
+    level = _check_level(level)
+    from distributed_tensorflow_tpu.parallel.data_parallel import (
+        make_dp_eval_step,
+    )
+
+    if level < 3:
+        return make_dp_eval_step(model, mesh)
+    meta = abstract_params(model)
+
+    def per_shard(pchunks, batch, model_state):
+        params = _gather_params(pchunks, meta)
+        _, aux = loss_and_metrics(model, params, batch, train=False,
+                                  model_state=model_state)
+        return lax.pmean(aux["metrics"], DATA_AXIS)
+
+    return jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), (P(DATA_AXIS), P(DATA_AXIS)), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def zero_memory_budget(model, optimizer, d: int) -> dict:
+    """STATIC per-chip memory budget (no chip, no compute —
+    ``jax.eval_shape``): param/grad/optimizer bytes per leaf and per
+    ``--zero`` level, so the D-fold saving is auditable anywhere
+    (``tools/trace_ops.py --mem`` prints it; bench.py records the
+    totals even in the degraded/outage record).
+
+    Per-chip accounting: replicated holds full params + full opt
+    state; ZeRO-1 holds full params + ceil(n/D) elements of every
+    params-shaped opt slot (padding included — the figures are what
+    the chips actually allocate); ZeRO-3 chunks the params the same
+    way. Grad bytes are the transient full-leaf backward output,
+    identical in every mode, listed for the complete picture."""
+    d = int(d)
+    if d < 1:
+        raise ValueError(f"data-axis size must be >= 1, got {d}")
+    abstract = jax.eval_shape(
+        lambda: create_train_state(model, optimizer))
+    rows: list[dict] = []
+
+    from distributed_tensorflow_tpu.utils.pytree import path_key
+
+    def add_rows(kind, tree, chunked: bool, prefix: str = ""):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            n = _leaf_size(leaf)
+            isz = np.dtype(leaf.dtype).itemsize
+            rows.append({
+                "kind": kind,
+                "leaf": (prefix + path_key(path)).rstrip("/") or "(scalar)",
+                "elements": n,
+                "bytes": n * isz,
+                "sharded_bytes": (-(-n // d)) * isz if chunked else n * isz,
+                "chunked": chunked,
+            })
+
+    add_rows("param", abstract.params, chunked=True)
+    pstruct = jax.tree.structure(abstract.params)
+
+    def walk_opt(entry, prefix: str):
+        # mirrors _map_params_shaped's rule (params-shaped subtrees are
+        # the chunked ones) but keeps the container path for the table
+        if jax.tree.structure(entry) == pstruct:
+            add_rows("opt", entry, chunked=True, prefix=prefix)
+        elif isinstance(entry, dict):
+            for k, v in entry.items():
+                walk_opt(v, f"{prefix}{k}/")
+        else:
+            add_rows("opt", entry, chunked=False, prefix=prefix)
+
+    walk_opt(abstract.opt_state, "")
+
+    def total(kind, key):
+        return sum(r[key] for r in rows if r["kind"] == kind)
+
+    p_full, p_shard = total("param", "bytes"), total("param", "sharded_bytes")
+    o_full, o_shard = total("opt", "bytes"), total("opt", "sharded_bytes")
+    per_chip = {
+        "replicated": {"params": p_full, "opt": o_full, "grads": p_full},
+        "zero1": {"params": p_full, "opt": o_shard, "grads": p_full},
+        "zero3": {"params": p_shard, "opt": o_shard, "grads": p_full},
+    }
+    return {
+        "d": d, "rows": rows,
+        "param_bytes": p_full, "opt_bytes": o_full,
+        "per_chip": per_chip,
+        "opt_reduction": (o_full / o_shard) if o_shard else 1.0,
+        "param_reduction": (p_full / p_shard) if p_shard else 1.0,
+    }

@@ -34,29 +34,41 @@ from distributed_tensorflow_tpu.training.train_state import (
 _SAMPLE_SALT = 0x5EED  # folds the sampling stream away from the dropout stream
 
 
+def _split_and_sample(state: TrainState, data, batch_size: int,
+                      axis: str | None, augment_fn):
+    """The ONE rng-evolution + on-device batch-draw rule every sampled
+    step body shares (``_sampled_step_body`` and the ZeRO device step —
+    their bit-identity contract is this function being common, not two
+    copies kept in lockstep): returns ``(next_rng, dropout_sub, batch)``.
+    ``state.rng`` advances every step, so the sampling key (a salted
+    fold of it) yields a fresh batch each iteration of a scan."""
+    rng, sub = jax.random.split(state.rng)
+    samp = jax.random.fold_in(state.rng, _SAMPLE_SALT)
+    if axis is not None:
+        # distinct sample + dropout streams per data shard
+        samp = jax.random.fold_in(samp, lax.axis_index(axis))
+        sub = jax.random.fold_in(sub, lax.axis_index(axis))
+    idx = jax.random.randint(samp, (batch_size,), 0, data.num_examples)
+    batch = (data.images[idx], data.labels[idx])
+    if augment_fn is not None:
+        # samp is already per-shard (axis fold above), so the salted
+        # augment stream decorrelates across shards too
+        batch = apply_augment(augment_fn, batch, samp)
+    return rng, sub, batch
+
+
 def _sampled_step_body(model, optimizer, batch_size: int, keep_prob: float,
                        axis: str | None, grad_transform=None,
                        batch_sharding=None, augment_fn=None):
     """(state, data) -> (state, metrics): one full train step — on-device
-    batch sample, forward, backward, (pmean over ``axis`` if set), update.
-    ``state.rng`` advances every step, so the sampling key (a salted fold of
-    it) yields a fresh batch each iteration of a scan. ``batch_sharding``
-    (global-view/GSPMD callers only) constrains the sampled batch's layout
-    so the partitioner splits the compute over the data axis."""
+    batch sample (``_split_and_sample``), forward, backward, (pmean over
+    ``axis`` if set), update. ``batch_sharding`` (global-view/GSPMD
+    callers only) constrains the sampled batch's layout so the
+    partitioner splits the compute over the data axis."""
 
     def body(state: TrainState, data):
-        rng, sub = jax.random.split(state.rng)
-        samp = jax.random.fold_in(state.rng, _SAMPLE_SALT)
-        if axis is not None:
-            # distinct sample + dropout streams per data shard
-            samp = jax.random.fold_in(samp, lax.axis_index(axis))
-            sub = jax.random.fold_in(sub, lax.axis_index(axis))
-        idx = jax.random.randint(samp, (batch_size,), 0, data.num_examples)
-        batch = (data.images[idx], data.labels[idx])
-        if augment_fn is not None:
-            # samp is already per-shard (axis fold above), so the salted
-            # augment stream decorrelates across shards too
-            batch = apply_augment(augment_fn, batch, samp)
+        rng, sub, batch = _split_and_sample(state, data, batch_size, axis,
+                                            augment_fn)
         if batch_sharding is not None:
             batch = tuple(
                 lax.with_sharding_constraint(b, s)
@@ -132,6 +144,60 @@ def make_device_dp_train_step(model, optimizer, mesh, batch_size: int, *,
         check_vma=False,
     )
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def make_zero_device_train_step(model, optimizer, mesh, level: int,
+                                batch_size: int, *,
+                                keep_prob: float = 1.0, chunk: int = 1,
+                                donate: bool = True, grad_transform=None,
+                                augment_fn=None):
+    """ZeRO-sharded chunked step over device-resident data — the
+    ``--zero`` composition of the headline input path. Sampling is the
+    DP device step's verbatim (same salted PRNG folds, replicated
+    split, ``batch_size // n_data`` rows per shard), so unclipped
+    trajectories bit-match ``make_device_dp_train_step``; what changes
+    is the update half (``parallel/zero._zero_step_core``): grads
+    reduce-scatter over the data axis, the optimizer updates each
+    rank's 1/D state shard, and — at level 1 — one all_gather rebuilds
+    the replicated params. ``grad_transform`` arrives already
+    axis-aware (``zero_clip_transform``)."""
+    from distributed_tensorflow_tpu.parallel.zero import (
+        _zero_step_core,
+        zero_state_specs,
+    )
+
+    n_data = mesh.shape[DATA_AXIS]
+    if batch_size % n_data:
+        raise ValueError(
+            f"batch_size={batch_size} not divisible by the {n_data}-way "
+            f"data axis")
+    local_batch = batch_size // n_data
+    core = _zero_step_core(model, optimizer, mesh, level, keep_prob,
+                           grad_transform)
+
+    def body(state: TrainState, data):
+        # _split_and_sample IS _sampled_step_body's sampler: every shard
+        # draws the same rows a replicated-DP run would
+        rng, sub, batch = _split_and_sample(state, data, local_batch,
+                                            DATA_AXIS, augment_fn)
+        return core(state, batch, sub, rng)
+
+    cache: dict = {}
+
+    def call(state, data):
+        fn = cache.get("fn")
+        if fn is None:
+            specs = zero_state_specs(state, level)
+            sharded = jax.shard_map(
+                _scan_chunk(body, chunk), mesh=mesh,
+                in_specs=(specs, P()),
+                out_specs=(specs, P()),
+                check_vma=False)
+            fn = cache["fn"] = jax.jit(
+                sharded, donate_argnums=(0,) if donate else ())
+        return fn(state, data)
+
+    return call
 
 
 def make_device_sp_train_step(sp_model, optimizer, mesh, batch_size: int, *,

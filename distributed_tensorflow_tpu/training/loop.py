@@ -147,6 +147,17 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     from distributed_tensorflow_tpu.utils import faults
 
     faults.configure_from_flags(FLAGS)
+    if int(getattr(FLAGS, "zero", 0) or 0) and mode != "sync":
+        # fail BEFORE dataset/model setup: the parse-time validator can
+        # only catch an EXPLICIT --mode=local/ps (--mode=auto resolves
+        # against the device count, unknowable at parse time — a 1-chip
+        # host lands here as "local")
+        raise ValueError(
+            f"--zero={FLAGS.zero} requires sync mode (a device mesh "
+            f"with a data axis to shard over); got mode={mode!r}. On a "
+            f"single-chip host --mode=auto resolves to local — ZeRO "
+            f"needs >1 local device to shard over (it is single-process "
+            f"in this version, so a multi-host launch won't help)")
     n_procs = jax.process_count()
     span = bool(getattr(FLAGS, "sp_span_hosts", False))
     if span and not getattr(FLAGS, "seq_parallel", False):
@@ -214,6 +225,16 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 f"--batch_size={FLAGS.batch_size} must be divisible by "
                 f"--accum_steps={accum}"
             )
+    if int(getattr(FLAGS, "zero", 0) or 0):
+        # ZeRO-sharded sync DP (parallel/zero.py): optimizer state (and,
+        # at level 3, the params) partitioned 1/D over the data axis —
+        # same math as replicated DP, D-fold less redundant HBM, and
+        # reduce-scatter+all-gather (|G|+|P|) on the wire instead of the
+        # all-reduce's 2|G|. Dispatched BEFORE the pipeline branch so a
+        # non-CLI caller combining the two hits _train_zero's loud
+        # rejection instead of silently training plain GPipe
+        return _train_zero(FLAGS, ds, model, opt, state, mode, accum,
+                           augment, model_axis)
     if getattr(FLAGS, "pipeline", False):
         if getattr(FLAGS, "seq_parallel", False) or \
                 getattr(FLAGS, "expert_parallel", False):
@@ -1250,6 +1271,317 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
         jax.block_until_ready(pp_state.params)
         host = fetch_state_pp(pp_state, model, k_stages=k_stages,
                               virtual_stages=vstages)
+        box.update(host, step)
+
+    test_metrics = _final_test_eval(FLAGS, sv, periodic_eval, model, host,
+                                    ds, logger, step)
+    print("Optimization Finished!")
+    logger.close()
+    return TrainResult(
+        final_step=step,
+        train_metrics=last_display,
+        test_metrics=test_metrics,
+        images_per_sec=meter.images_per_sec,
+        images_per_sec_per_chip=meter.images_per_sec_per_chip,
+        n_chips=n_chips,
+    )
+
+
+def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
+                model_axis) -> TrainResult:
+    """--zero training: ZeRO-sharded synchronous data parallelism
+    (parallel/zero.py). Level 1 shards the optimizer state 1/D per data
+    rank (grads reduce-scatter, one all_gather rebuilds the updated
+    replicated params); level 3 keeps the params themselves sharded and
+    gathers them inside forward/backward. Trajectories are BIT-IDENTICAL
+    to replicated sync DP (tests/test_zero.py) — only the collective
+    pattern and the per-chip footprint change.
+
+    The live state holds the ZeRO (flat-chunk) layout between steps;
+    checkpoints stay in the STANDARD layout (``fetch_state_zero`` at
+    display / eval / cadence boundaries, which is also when the StateBox
+    updates — the PP loops' contract: clean exits and SIGTERM drains
+    save the exact final state, a hard kill loses at most the steps
+    since the last boundary, and a ``--zero`` run restores a replicated
+    checkpoint and vice versa). --clip_norm runs the AXIS-AWARE
+    transform (``zero_clip_transform``): every in-step grad leaf is a
+    distinct 1/D shard, so squared-norm partials psum over the data
+    axis before one scale applies everywhere."""
+    from distributed_tensorflow_tpu.parallel.zero import (
+        _check_level,
+        fetch_state_zero,
+        make_zero_eval_step,
+        make_zero_train_step,
+        shard_state_zero,
+        zero_clip_transform,
+    )
+
+    level = _check_level(FLAGS.zero)
+    # the library-layer re-checks (the flags validator is the CLI front
+    # door; non-CLI callers land here)
+    if mode != "sync":
+        raise ValueError(f"--zero={level} requires sync mode (a device "
+                         f"mesh with a data axis to shard over); got "
+                         f"mode={mode!r}")
+    if model_axis > 1 or getattr(FLAGS, "pipeline", False) or \
+            getattr(FLAGS, "seq_parallel", False) or \
+            getattr(FLAGS, "expert_parallel", False):
+        raise ValueError(f"--zero={level} shards the whole TrainState "
+                         f"over the DATA axis and cannot compose with a "
+                         f"model-axis strategy (--model_axis>1/--pipeline/"
+                         f"--seq_parallel/--expert_parallel) — drop one")
+    if jax.process_count() > 1:
+        raise ValueError(f"--zero={level} is single-process in this "
+                         f"version (cross-host state shards would need "
+                         f"the sharded-checkpoint collective fetch)")
+    from distributed_tensorflow_tpu.parallel import make_mesh as _mk
+
+    mesh = _mk()
+    n_chips = mesh.devices.size
+    if n_chips == 1:
+        print(f"--zero={level} on a 1-chip mesh: the data axis has "
+              f"nothing to shard over — identical math to replicated "
+              f"DP, no memory or comm saving (legal, but pointless)")
+    if FLAGS.batch_size % n_chips:
+        raise ValueError(
+            f"--batch_size={FLAGS.batch_size} must be divisible by the "
+            f"{n_chips} devices in the data mesh")
+    if accum > 1 and (FLAGS.batch_size // n_chips) % accum:
+        raise ValueError(
+            f"each device's batch slice ({FLAGS.batch_size // n_chips} "
+            f"examples) must split into {accum} equal microbatches")
+    clip = (zero_clip_transform(FLAGS.clip_norm)
+            if getattr(FLAGS, "clip_norm", 0.0) > 0 else None)
+
+    if getattr(FLAGS, "device_data", False):
+        return _train_zero_device(FLAGS, ds, model, opt, state, mesh,
+                                  n_chips, level, clip, augment_fn)
+
+    step_fn = make_zero_train_step(model, opt, mesh, level,
+                                   keep_prob=FLAGS.keep_prob,
+                                   grad_transform=clip, accum_steps=accum,
+                                   augment_fn=augment_fn)
+    eval_fn = make_zero_eval_step(model, mesh, level)
+    sv = Supervisor(
+        is_chief=(FLAGS.task_index == 0),
+        logdir=FLAGS.logdir,
+        save_model_secs=FLAGS.save_model_secs,
+        max_to_keep=max_to_keep_from_flags(FLAGS),
+        background_save=background_save_from_flags(FLAGS),
+        sharded_spanning=bool(getattr(FLAGS, "sharded_checkpoint", True)),
+    )
+    logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
+                           job_name=FLAGS.job_name or "worker",
+                           task_index=FLAGS.task_index)
+    meter = Throughput(FLAGS.batch_size, n_chips)
+    last_display = {}
+    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
+    eval_every = max(0, getattr(FLAGS, "eval_step", 0))
+    sync_every = collective_sync_cadence(True)
+
+    with sv.managed(state) as box:
+        step = box.step
+        _log_recovery(sv, logger, step)
+        periodic_eval.prime(step)
+        z_state = shard_state_zero(box.state, mesh, level)
+        host = box.state
+        batches = prefetch_to_device(
+            batch_iterator(ds.train, FLAGS.batch_size,
+                           raw=FLAGS.raw_input),
+            size=2,
+            stage=lambda b: shard_batch(mesh, b),
+        )
+        compile_done = False
+        profiling = False
+        profile_done = not FLAGS.profile_dir
+        try:
+            meter.reset()
+            while not sv.should_stop() and step < FLAGS.training_iter:
+                batch = next(batches)
+                if step % FLAGS.display_step == 0:
+                    # reference display semantics: dropout-off eval of
+                    # the upcoming batch before the update
+                    # (MNISTDist.py:179-182) — level 3 gathers the
+                    # param chunks inside the sharded eval step
+                    m = eval_fn(z_state.params, batch,
+                                z_state.model_state)
+                    last_display = {k: float(v) for k, v in m.items()}
+                    logger.log_display(step, last_display["loss"],
+                                       last_display["accuracy"])
+                    logger.scalars(
+                        step, {"images_per_sec": meter.images_per_sec})
+                if compile_done and not profile_done and not profiling:
+                    jax.profiler.start_trace(FLAGS.profile_dir)
+                    profiling = True
+                    profile_stop_at = step + FLAGS.profile_steps
+                z_state, step_m = step_fn(z_state, batch)
+                step += 1
+                meter.step()
+                if sync_every and step % sync_every == 0:
+                    jax.block_until_ready((z_state.params, step_m))
+                if not compile_done:
+                    jax.block_until_ready(z_state.params)
+                    meter.reset()
+                    compile_done = True
+                if profiling and step >= profile_stop_at:
+                    jax.block_until_ready(z_state.params)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    profile_done = True
+                boundary = (step % FLAGS.display_step == 0
+                            or (eval_every and step % eval_every == 0)
+                            or sv.checkpointer.cadence_due()
+                            or step >= FLAGS.training_iter)
+                if boundary:
+                    host = fetch_state_zero(z_state, model, level)
+                    box.update(host, step)
+                    periodic_eval(host, step)
+                    sv.maybe_checkpoint(host, step)
+            jax.block_until_ready(z_state.params)
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
+            batches.close()
+        host = fetch_state_zero(z_state, model, level)
+        box.update(host, step)
+
+    test_metrics = _final_test_eval(FLAGS, sv, periodic_eval, model, host,
+                                    ds, logger, step)
+    print("Optimization Finished!")
+    logger.close()
+    return TrainResult(
+        final_step=step,
+        train_metrics=last_display,
+        test_metrics=test_metrics,
+        images_per_sec=meter.images_per_sec,
+        images_per_sec_per_chip=meter.images_per_sec_per_chip,
+        n_chips=n_chips,
+    )
+
+
+def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
+                       level, clip, augment_fn) -> TrainResult:
+    """--zero --device_data: the ZeRO-sharded update over a DEVICE-
+    RESIDENT split. The split stages replicated into HBM exactly like
+    the plain DP device loop (every rank samples its own rows with the
+    DATA-folded key — identical rows to a replicated-DP run), and
+    ``lax.scan`` runs ``--device_chunk`` steps per dispatch
+    (device_step.make_zero_device_train_step) — zero host->device bytes
+    per step. The live state keeps the ZeRO layout between dispatches;
+    the standard-layout host state (checkpoint format) is fetched only
+    at display / eval / cadence boundaries (the PP device loop's
+    contract, which also makes mid-chunk resume land on the replicated
+    trajectory bit-for-bit)."""
+    import math
+
+    from distributed_tensorflow_tpu.data.device_data import put_device_data
+    from distributed_tensorflow_tpu.parallel.zero import (
+        fetch_state_zero,
+        make_zero_eval_step,
+        shard_state_zero,
+    )
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_zero_device_train_step,
+    )
+
+    data = put_device_data(ds.train, mesh)
+    eval_fn = make_zero_eval_step(model, mesh, level)
+    chunk = max(1, math.gcd(FLAGS.display_step, max(1, FLAGS.device_chunk)))
+    if chunk != FLAGS.device_chunk:
+        print(f"--device_chunk={FLAGS.device_chunk} clamped to {chunk} so "
+              f"chunks land on --display_step={FLAGS.display_step} "
+              f"boundaries (dispatch amortization shrinks accordingly)")
+
+    chunk_fns: dict[int, Any] = {}
+
+    def run_chunk(z_state, length: int):
+        fn = chunk_fns.get(length)
+        if fn is None:
+            fn = chunk_fns[length] = make_zero_device_train_step(
+                model, opt, mesh, level, FLAGS.batch_size,
+                keep_prob=FLAGS.keep_prob, chunk=length,
+                grad_transform=clip, augment_fn=augment_fn)
+        return fn(z_state, data)
+
+    sv = Supervisor(
+        is_chief=(FLAGS.task_index == 0),
+        logdir=FLAGS.logdir,
+        save_model_secs=FLAGS.save_model_secs,
+        max_to_keep=max_to_keep_from_flags(FLAGS),
+        background_save=background_save_from_flags(FLAGS),
+        sharded_spanning=bool(getattr(FLAGS, "sharded_checkpoint", True)),
+    )
+    logger = MetricsLogger(FLAGS.logdir if sv.is_chief else None,
+                           job_name=FLAGS.job_name or "worker",
+                           task_index=FLAGS.task_index)
+    meter = Throughput(FLAGS.batch_size, n_chips)
+    last_display = {}
+    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
+    eval_every = max(0, getattr(FLAGS, "eval_step", 0))
+    sync_every = collective_sync_cadence(True)
+    chunks_done = 0
+
+    with sv.managed(state) as box:
+        step = box.step
+        _log_recovery(sv, logger, step)
+        periodic_eval.prime(step)
+        z_state = shard_state_zero(box.state, mesh, level)
+        host = box.state
+        compile_done = False
+        profiling = False
+        profile_done = not FLAGS.profile_dir
+        meter.reset()
+        while not sv.should_stop() and step < FLAGS.training_iter:
+            if step % FLAGS.display_step == 0:
+                # reference display semantics, same as the DP device
+                # loop: dropout-off eval of a fresh host batch before
+                # training continues
+                b = ds.train.next_batch(FLAGS.batch_size)
+                m = eval_fn(z_state.params, shard_batch(mesh, b),
+                            z_state.model_state)
+                last_display = {k: float(v) for k, v in m.items()}
+                logger.log_display(step, last_display["loss"],
+                                   last_display["accuracy"])
+                logger.scalars(step,
+                               {"images_per_sec": meter.images_per_sec})
+            if compile_done and not profile_done and not profiling:
+                jax.profiler.start_trace(FLAGS.profile_dir)
+                profiling = True
+                profile_stop_at = step + max(FLAGS.profile_steps, chunk)
+            # realign to display boundaries after a resume from an
+            # arbitrary checkpointed step, then cap at the budget
+            to_boundary = -step % FLAGS.display_step or chunk
+            length = min(chunk, to_boundary, FLAGS.training_iter - step)
+            z_state, train_m = run_chunk(z_state, length)
+            step += length
+            meter.step(length * FLAGS.batch_size)
+            chunks_done += 1
+            if sync_every and chunks_done % max(1, sync_every // chunk) == 0:
+                jax.block_until_ready((z_state.params, train_m))
+            if not compile_done:
+                jax.block_until_ready(z_state.params)
+                meter.reset()
+                compile_done = True
+            if profiling and step >= profile_stop_at:
+                jax.block_until_ready(z_state.params)
+                jax.profiler.stop_trace()
+                profiling = False
+                profile_done = True
+            boundary = (step % FLAGS.display_step == 0
+                        or (eval_every and
+                            (step - length) // eval_every
+                            != step // eval_every)
+                        or sv.checkpointer.cadence_due()
+                        or step >= FLAGS.training_iter)
+            if boundary:
+                host = fetch_state_zero(z_state, model, level)
+                box.update(host, step)
+                periodic_eval(host, step)
+                sv.maybe_checkpoint(host, step)
+        jax.block_until_ready(z_state.params)
+        if profiling:
+            jax.profiler.stop_trace()
+        host = fetch_state_zero(z_state, model, level)
         box.update(host, step)
 
     test_metrics = _final_test_eval(FLAGS, sv, periodic_eval, model, host,

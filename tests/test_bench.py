@@ -345,3 +345,58 @@ def test_lm_largevocab_phase_runs(monkeypatch):
     assert out["lm_bigvocab_tokens_per_sec_per_chip"] > 0
     assert out["lm_bigvocab_vocab"] == 512
     assert out["lm_bigvocab_seq_len"] == 64
+
+
+# ---- r10: the dp_zero phase (replicated vs --zero 1 A/B + analytic
+# memory facts; the facts must survive outages and 1-chip skips) ----
+
+
+_ZERO_ANALYTIC_KEYS = (
+    "zero_data_ways", "zero_opt_bytes_per_chip",
+    "zero_opt_bytes_per_chip_replicated", "zero_opt_reduction",
+    "zero3_param_bytes_per_chip", "zero_param_reduction",
+    "zero_comm_bytes_allreduce", "zero_comm_bytes_reduce_scatter_gather",
+    "zero_live_bytes_per_chip", "dp_live_bytes_per_chip",
+    "zero_live_bytes_source",
+)
+
+
+@pytest.mark.slow
+def test_dp_zero_phase_runs(monkeypatch, ds):
+    monkeypatch.setattr(bench, "PER_CHIP_BATCH", 8)
+    monkeypatch.setattr(bench, "CHUNK", 2)
+    monkeypatch.setattr(bench, "ZERO_TIMED_CHUNKS", 2)
+    out = bench.dp_zero_phase(ds, 8)
+    assert out["zero_images_per_sec_per_chip"] > 0
+    assert out["dp_ab_images_per_sec_per_chip"] > 0
+    assert out["zero_data_ways"] == 8
+    assert out["zero_opt_reduction"] >= 7.9
+    for k in _ZERO_ANALYTIC_KEYS:
+        assert out[k] is not None, k
+    # CPU backend has no memory_stats -> the analytic totals stand in
+    assert out["zero_live_bytes_source"] in ("analytic", "memory_stats")
+
+
+def test_dp_zero_phase_skips_on_one_chip(ds):
+    """1 chip = nothing to shard over: null rates with a reason, the
+    analytic facts (2-way fallback config) still present."""
+    out = bench.dp_zero_phase(ds, 1)
+    assert out["zero_images_per_sec_per_chip"] is None
+    assert out["dp_ab_images_per_sec_per_chip"] is None
+    assert "zero_skipped" in out
+    assert out["zero_data_ways"] == 2
+    assert out["zero_opt_reduction"] >= 1.9
+
+
+def test_degraded_record_keeps_zero_facts_non_null():
+    """Outage artifacts null the measured A/B rates but carry every
+    analytic ZeRO memory/comm fact (the r8-r9 hardened-artifact
+    convention)."""
+    rec = bench.degraded_record("UNAVAILABLE: tunnel down", {},
+                                cpu_smoke=False)
+    assert rec["zero_images_per_sec_per_chip"] is None
+    assert rec["dp_ab_images_per_sec_per_chip"] is None
+    for k in _ZERO_ANALYTIC_KEYS:
+        assert rec[k] is not None, k
+    assert rec["zero_live_bytes_source"] == "analytic"
+    assert rec["zero_data_ways"] == 2

@@ -1,0 +1,555 @@
+"""ZeRO-sharded data parallelism (parallel/zero.py): exact trajectories
+vs replicated sync DP on the 8-device virtual mesh, the reduce-scatter /
+all-gather-transpose collective pin, cross-topology checkpoints through
+the verified-restore ladder, the static memory budget, and the --zero
+flag surface."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.models import MLP, DeepCNN, ResNet20
+from distributed_tensorflow_tpu.parallel import (
+    make_dp_train_step,
+    make_mesh,
+    shard_batch,
+)
+from distributed_tensorflow_tpu.parallel.data_parallel import (
+    make_dp_eval_step,
+    replicate_state,
+)
+from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS
+from distributed_tensorflow_tpu.parallel.zero import (
+    fetch_state_zero,
+    make_zero_eval_step,
+    make_zero_train_step,
+    shard_state_zero,
+    zero_clip_transform,
+    zero_memory_budget,
+)
+from distributed_tensorflow_tpu.training import (
+    adam,
+    create_train_state,
+    get_optimizer,
+    sgd,
+)
+from distributed_tensorflow_tpu.training.train_state import momentum
+from distributed_tensorflow_tpu.training.train_state import (
+    clip_by_global_norm,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _batch(n=32, seed=1, pixels=784):
+    x = jax.random.normal(jax.random.key(seed), (n, pixels))
+    y = jax.nn.one_hot(jnp.arange(n) % 10, 10)
+    return x, y
+
+
+def _assert_trees_equal(a, b, exact=True, rtol=1e-4, atol=1e-6):
+    la = jax.tree_util.tree_flatten_with_path(a)[0]
+    lb = jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for (path, x), y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        if exact:
+            np.testing.assert_array_equal(x, y, err_msg=str(path))
+        else:  # clipped runs: last-ulp partial-assembly divergence,
+            # amplified over a few adam steps
+            np.testing.assert_allclose(x, y, rtol=rtol, atol=atol,
+                                       err_msg=str(path))
+
+
+def _run_pair(mesh, model, opt, level, *, steps=3, keep_prob=1.0,
+              accum_steps=1, dp_clip=None, zero_clip=None, seed=0,
+              batch=None, exact_metrics=True):
+    """Run replicated DP and ZeRO side by side on the same batches from
+    the same seed; return (dp_host_state, zero_host_state)."""
+    state0 = create_train_state(model, opt, seed=seed)
+    batch = shard_batch(mesh, batch if batch is not None else _batch())
+    dp = make_dp_train_step(model, opt, mesh, keep_prob=keep_prob,
+                            donate=False, grad_transform=dp_clip,
+                            accum_steps=accum_steps)
+    z = make_zero_train_step(model, opt, mesh, level, keep_prob=keep_prob,
+                             donate=False, grad_transform=zero_clip,
+                             accum_steps=accum_steps)
+    s_dp = replicate_state(mesh, state0)
+    s_z = shard_state_zero(state0, mesh, level)
+    for _ in range(steps):
+        s_dp, m_dp = dp(s_dp, batch)
+        s_z, m_z = z(s_z, batch)
+        if exact_metrics:
+            np.testing.assert_array_equal(np.asarray(m_dp["loss"]),
+                                          np.asarray(m_z["loss"]))
+        else:  # clipped: last-ulp partial-assembly divergence is allowed
+            np.testing.assert_allclose(np.asarray(m_dp["loss"]),
+                                       np.asarray(m_z["loss"]), rtol=1e-5)
+    return jax.device_get(s_dp), fetch_state_zero(s_z, model, level)
+
+
+# ---------------------------------------------- exact trajectories
+
+
+def test_zero1_trajectory_bitmatches_dp_with_dropout(mesh):
+    """--zero 1 == replicated sync DP bit-for-bit, dropout on: same rng
+    folds, same summed gradient (psum_scatter chunks the psum), same
+    elementwise update — only the collective pattern changes."""
+    hd, hz = _run_pair(mesh, DeepCNN(), adam(1e-3), 1, keep_prob=0.8)
+    _assert_trees_equal(hd.params, hz.params)
+    _assert_trees_equal(hd.opt_state, hz.opt_state)
+    np.testing.assert_array_equal(np.asarray(hd.rng), np.asarray(hz.rng))
+    assert int(hd.step) == int(hz.step) == 3
+
+
+@pytest.mark.parametrize("model_cls", [MLP, DeepCNN])
+def test_zero3_trajectory_bitmatches_dp(mesh, model_cls):
+    """--zero 3 (params live sharded, gathered in forward/backward):
+    still bit-identical — the all_gather transpose delivers the same
+    chunks the explicit reduce-scatter would."""
+    hd, hz = _run_pair(mesh, model_cls(), adam(1e-3), 3, keep_prob=0.8)
+    _assert_trees_equal(hd.params, hz.params)
+    _assert_trees_equal(hd.opt_state, hz.opt_state)
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "momentum"])
+def test_zero1_other_optimizers_bitmatch(mesh, opt_name):
+    """Empty (sgd) and bare params-shaped (momentum velocity) opt_state
+    layouts both survive the params-shaped-subtree chunking rule."""
+    opt = {"sgd": sgd(0.05), "momentum": momentum(0.05)}[opt_name]
+    hd, hz = _run_pair(mesh, DeepCNN(), opt, 1)
+    _assert_trees_equal(hd.params, hz.params)
+    _assert_trees_equal(hd.opt_state, hz.opt_state)
+
+
+@pytest.mark.parametrize("level", [1, 3])
+def test_zero_accum_steps_bitmatches_dp(mesh, level):
+    """accum_steps > 1: ZeRO accumulates full local grads exactly like
+    the replicated step (one gather per step at level 3, one
+    reduce-scatter after the scan) — bitwise equal."""
+    hd, hz = _run_pair(mesh, DeepCNN(), adam(1e-3), level, keep_prob=0.8,
+                       accum_steps=2)
+    _assert_trees_equal(hd.params, hz.params)
+    _assert_trees_equal(hd.opt_state, hz.opt_state)
+
+
+def test_zero_clip_matches_dp_to_tolerance_and_levels_bitmatch(mesh):
+    """--clip_norm: the ZeRO transform psums per-shard squared-norm
+    partials, so the clipped trajectory matches replicated DP to float
+    tolerance (partial-assembly order differs in the last ulp) while
+    staying BIT-identical across ZeRO levels."""
+    kw = dict(steps=3, keep_prob=0.8, dp_clip=clip_by_global_norm(0.5),
+              zero_clip=zero_clip_transform(0.5), exact_metrics=False)
+    hd, hz1 = _run_pair(mesh, DeepCNN(), adam(1e-3), 1, **kw)
+    _, hz3 = _run_pair(mesh, DeepCNN(), adam(1e-3), 3, **kw)
+    _assert_trees_equal(hd.params, hz1.params, exact=False)
+    _assert_trees_equal(hz1.params, hz3.params)  # bitwise across levels
+    _assert_trees_equal(hz1.opt_state, hz3.opt_state)
+
+
+def test_zero1_stateful_model_state_bitmatches(mesh):
+    """Batch-norm running stats (model_state) pmean over the data axis
+    exactly as replicated DP does."""
+    model = ResNet20()
+    x = jax.random.normal(jax.random.key(2), (16, 32 * 32 * 3))
+    y = jax.nn.one_hot(jnp.arange(16) % 10, 10)
+    hd, hz = _run_pair(mesh, model, momentum(0.1), 1, steps=2,
+                       batch=(x, y))
+    _assert_trees_equal(hd.params, hz.params)
+    _assert_trees_equal(hd.model_state, hz.model_state)
+
+
+def test_zero1_replicated_leaves_bit_identical_across_devices(mesh):
+    """After every step, every device holds the SAME updated params (the
+    all-gathered result) — the sync invariant replicated DP has, kept."""
+    model = DeepCNN()
+    opt = adam(1e-3)
+    state = shard_state_zero(create_train_state(model, opt, seed=0),
+                             mesh, 1)
+    step = make_zero_train_step(model, opt, mesh, 1, keep_prob=0.8,
+                                donate=False)
+    batch = shard_batch(mesh, _batch())
+    for _ in range(2):
+        state, _ = step(state, batch)
+        for leaf in jax.tree.leaves(state.params):
+            shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+            assert len(shards) == 8
+            for s in shards[1:]:
+                np.testing.assert_array_equal(shards[0], s)
+
+
+# ---------------------------------------------- collective-level pins
+
+
+def test_all_gather_transpose_is_psum_scatter(mesh):
+    """The ZeRO-3 gradient path rests on this: differentiating through a
+    tiled all_gather routes each rank's cotangent into the owning rank's
+    chunk — bitwise equal to the explicit psum_scatter ZeRO-1 uses."""
+    d = 8
+    c = 5  # chunk length per rank
+    g = jax.random.normal(jax.random.key(3), (d, d * c))
+
+    def per_shard(g_row):
+        g_local = g_row.reshape(-1)
+        chunk0 = jnp.zeros((c,), g_local.dtype)
+        _, vjp = jax.vjp(
+            lambda ch: lax.all_gather(ch, DATA_AXIS, tiled=True), chunk0)
+        (via_transpose,) = vjp(g_local)
+        explicit = lax.psum_scatter(g_local, DATA_AXIS,
+                                    scatter_dimension=0, tiled=True)
+        return via_transpose[None], explicit[None]
+
+    fn = jax.shard_map(per_shard, mesh=mesh,
+                       in_specs=P(DATA_AXIS, None),
+                       out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+                       check_vma=False)
+    via_transpose, explicit = fn(g)
+    np.testing.assert_array_equal(np.asarray(via_transpose),
+                                  np.asarray(explicit))
+
+
+def test_shard_fetch_roundtrip_and_padding(mesh):
+    """shard_state_zero -> fetch_state_zero is the identity on the
+    standard layout, and the device layout really is flat 1/D chunks:
+    every chunked leaf holds ceil(n/D) elements per device."""
+    model = DeepCNN()
+    state = create_train_state(model, adam(1e-3), seed=4)
+    z = shard_state_zero(state, mesh, 3)
+    for leaf in jax.tree.leaves(z.params):
+        assert leaf.ndim == 1 and leaf.shape[0] % 8 == 0
+        assert leaf.addressable_shards[0].data.shape[0] == leaf.shape[0] // 8
+    back = fetch_state_zero(z, model, 3)
+    _assert_trees_equal(state.params, back.params)
+    _assert_trees_equal(state.opt_state, back.opt_state)
+    np.testing.assert_array_equal(np.asarray(state.rng),
+                                  np.asarray(back.rng))
+
+
+def test_zero_eval_step_matches_dp_eval(mesh):
+    """Level-3 eval gathers the param chunks inside shard_map; metrics
+    bit-match the replicated DP eval on the same params."""
+    model = DeepCNN()
+    state = create_train_state(model, adam(1e-3), seed=5)
+    batch = shard_batch(mesh, _batch(seed=6))
+    m_dp = make_dp_eval_step(model, mesh)(
+        replicate_state(mesh, state).params, batch, ())
+    z = shard_state_zero(state, mesh, 3)
+    m_z = make_zero_eval_step(model, mesh, 3)(z.params, batch, ())
+    np.testing.assert_array_equal(np.asarray(m_dp["loss"]),
+                                  np.asarray(m_z["loss"]))
+    np.testing.assert_array_equal(np.asarray(m_dp["accuracy"]),
+                                  np.asarray(m_z["accuracy"]))
+
+
+@pytest.mark.parametrize("level", [1, 3])
+def test_zero_device_step_bitmatches_dp_device_step(mesh, level):
+    """--zero --device_data: the resident-split sampler is the DP device
+    step's verbatim, so chunked trajectories bit-match it — at level 3
+    this pins the remat'd gather inside the lax.scan chunk too."""
+    from distributed_tensorflow_tpu.data import read_data_sets
+    from distributed_tensorflow_tpu.data.device_data import put_device_data
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_device_dp_train_step,
+        make_zero_device_train_step,
+    )
+
+    ds = read_data_sets("/nonexistent-zero", one_hot=True)
+    data = put_device_data(ds.train, mesh)
+    model = DeepCNN()
+    opt = adam(1e-3)
+    state0 = create_train_state(model, opt, seed=0)
+
+    dp = make_device_dp_train_step(model, opt, mesh, 32, keep_prob=0.8,
+                                   chunk=2, donate=False)
+    s_dp, _ = dp(replicate_state(mesh, state0), data)
+    s_dp, _ = dp(s_dp, data)
+
+    z = make_zero_device_train_step(model, opt, mesh, level, 32,
+                                    keep_prob=0.8, chunk=2, donate=False)
+    s_z = shard_state_zero(state0, mesh, level)
+    s_z, _ = z(s_z, data)
+    s_z, _ = z(s_z, data)
+    hz = fetch_state_zero(s_z, model, level)
+    hd = jax.device_get(s_dp)
+    assert int(hz.step) == 4
+    _assert_trees_equal(hd.params, hz.params)
+    _assert_trees_equal(hd.opt_state, hz.opt_state)
+
+
+# ---------------------------------------------- cross-topology ckpts
+
+
+def _ckpt_template(model, opt):
+    return create_train_state(model, opt, seed=9)
+
+
+@pytest.mark.parametrize("level", [1, 3])
+def test_checkpoint_zero_to_replicated_and_back(tmp_path, level):
+    """Checkpoints are STANDARD-layout whatever --zero level wrote them:
+    save mid-run under ZeRO -> restore replicated (and the reverse),
+    both through restore_with_fallback, and finish bit-identical to an
+    uninterrupted replicated run."""
+    from distributed_tensorflow_tpu.checkpoint import (
+        restore_with_fallback,
+        save_checkpoint,
+    )
+
+    mesh = make_mesh()
+    model = DeepCNN()
+    opt = adam(1e-3)
+    base = create_train_state(model, opt, seed=3)
+    batches = [shard_batch(mesh, _batch(seed=s)) for s in (10, 11)]
+
+    dp = make_dp_train_step(model, opt, mesh, keep_prob=0.8, donate=False)
+    z = make_zero_train_step(model, opt, mesh, level, keep_prob=0.8,
+                             donate=False)
+
+    # uninterrupted replicated reference over both batches
+    ref = replicate_state(mesh, base)
+    for b in batches:
+        ref, _ = dp(ref, b)
+    ref = jax.device_get(ref)
+
+    # zero writes step 1 -> replicated resumes
+    s_z, _ = z(shard_state_zero(base, mesh, level), batches[0])
+    d1 = str(tmp_path / f"z{level}_to_dp")
+    save_checkpoint(d1, fetch_state_zero(s_z, model, level), step=1)
+    got, step, report = restore_with_fallback(d1, _ckpt_template(model, opt))
+    assert step == 1 and report.fallback_depth == 0
+    done, _ = dp(replicate_state(mesh, got), batches[1])
+    _assert_trees_equal(ref.params, jax.device_get(done).params)
+
+    # replicated writes step 1 -> zero resumes
+    s_dp, _ = dp(replicate_state(mesh, base), batches[0])
+    d2 = str(tmp_path / f"dp_to_z{level}")
+    save_checkpoint(d2, jax.device_get(s_dp), step=1)
+    got, step, report = restore_with_fallback(d2, _ckpt_template(model, opt))
+    assert step == 1 and report.fallback_depth == 0
+    s_z, _ = z(shard_state_zero(got, mesh, level), batches[1])
+    done = fetch_state_zero(s_z, model, level)
+    _assert_trees_equal(ref.params, done.params)
+    _assert_trees_equal(ref.opt_state, done.opt_state)
+
+
+def test_corrupt_newest_zero_checkpoint_rides_the_ladder(tmp_path):
+    """A ZeRO-written set torn mid-file (the machine-crash signature)
+    quarantines and the ladder restores the older complete set — same
+    guarantees as replicated-written checkpoints (it IS the same
+    format)."""
+    from distributed_tensorflow_tpu.checkpoint import (
+        restore_with_fallback,
+        save_checkpoint,
+    )
+
+    mesh = make_mesh()
+    model = DeepCNN()
+    opt = adam(1e-3)
+    z = make_zero_train_step(model, opt, mesh, 1, donate=False)
+    s_z = shard_state_zero(create_train_state(model, opt, seed=3), mesh, 1)
+    d = str(tmp_path)
+    batch = shard_batch(mesh, _batch())
+    s_z, _ = z(s_z, batch)
+    save_checkpoint(d, fetch_state_zero(s_z, model, 1), step=1)
+    keep = fetch_state_zero(s_z, model, 1)
+    s_z, _ = z(s_z, batch)
+    save_checkpoint(d, fetch_state_zero(s_z, model, 1), step=2)
+    p = os.path.join(d, "ckpt-2.npz")
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+
+    got, step, report = restore_with_fallback(d, _ckpt_template(model, opt))
+    assert step == 1 and report.fallback_depth == 1
+    assert report.quarantined  # the torn set is out of selection for good
+    _assert_trees_equal(keep.params, got.params)
+    # the restored standard-layout state re-shards cleanly
+    back = shard_state_zero(got, mesh, 1)
+    assert int(back.step) == 1
+
+
+def _parse(flags, args):
+    flags.FLAGS._reset()
+    flags.FLAGS._parse(args)
+    return flags.FLAGS
+
+
+def test_device_zero_mid_chunk_resume_matches_replicated(tmp_path):
+    """--zero 1 --device_data through the production CLI: stop at a step
+    that is NOT a chunk boundary, resume, and land bit-identical to an
+    uninterrupted REPLICATED --device_data run — mid-chunk resume and
+    cross-topology equivalence in one pass."""
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.checkpoint import restore_latest
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+
+    def args_for(logdir, iters, zero):
+        return [f"--logdir={logdir}", f"--data_dir={tmp_path}/none",
+                f"--zero={zero}", "--batch_size=32", "--optimizer=adam",
+                f"--training_iter={iters}", "--display_step=3",
+                "--device_data", "--device_chunk=3",
+                "--test_eval=false"]
+
+    try:
+        # interrupted zero run: 5 steps (chunks 3 + 2), resume to 9
+        res = train(_parse(flags, args_for(f"{tmp_path}/a", 5, 1)),
+                    mode="sync")
+        assert res.final_step == 5
+        res = train(_parse(flags, args_for(f"{tmp_path}/a", 9, 1)),
+                    mode="sync")
+        assert res.final_step == 9
+        # uninterrupted replicated run: straight to 9
+        res_b = train(_parse(flags, args_for(f"{tmp_path}/b", 9, 0)),
+                      mode="sync")
+        assert res_b.final_step == 9
+    finally:
+        flags.FLAGS._reset()
+
+    model = DeepCNN()
+    opt = get_optimizer("adam", 0.001)
+    tmpl = lambda: create_train_state(model, opt, seed=9)
+    got_a, step_a = restore_latest(f"{tmp_path}/a", tmpl())
+    got_b, step_b = restore_latest(f"{tmp_path}/b", tmpl())
+    assert step_a == step_b == 9
+    _assert_trees_equal(got_b.params, got_a.params)
+    _assert_trees_equal(got_b.opt_state, got_a.opt_state)
+
+
+# ---------------------------------------------- guard rails
+
+
+def test_replicate_state_refuses_zero_sharded_layout():
+    """The satellite fix: silently re-replicating a ZeRO (flat padded
+    chunk) layout would train on garbage — replicate_state must refuse
+    loudly, and keep accepting host-built and replicated states."""
+    mesh = make_mesh()
+    model = DeepCNN()
+    state = create_train_state(model, adam(1e-3), seed=0)
+    z = shard_state_zero(state, mesh, 1)
+    with pytest.raises(ValueError, match="already"):
+        replicate_state(mesh, z)
+    # host state and an already-replicated state still place fine
+    r = replicate_state(mesh, state)
+    r2 = replicate_state(mesh, r)
+    assert jax.tree.leaves(r2.params)[0].is_fully_replicated
+
+
+def test_zero_level_check():
+    from distributed_tensorflow_tpu.parallel.zero import _check_level
+
+    assert _check_level(1) == 1 and _check_level(3) == 3
+    for bad in (0, 2, 4):
+        with pytest.raises(ValueError, match="zero level"):
+            _check_level(bad)
+
+
+def test_zero_rejects_model_axis_strategies_in_loop(tmp_path):
+    """The library-layer re-check: non-CLI callers that hand train() a
+    colliding config still get the loud error, mid-setup not mid-trace."""
+    from distributed_tensorflow_tpu import flags
+    from distributed_tensorflow_tpu.training.loop import train
+
+    flags.define_reference_flags()
+    try:
+        F = _parse(flags, [f"--logdir={tmp_path}/x",
+                           f"--data_dir={tmp_path}/none", "--batch_size=32",
+                           "--training_iter=2", "--test_eval=false"])
+        # bypass the parse-time validator by mutating post-parse
+        F.zero = 1
+        F.expert_parallel = True
+        with pytest.raises(ValueError, match="model-axis"):
+            train(F, mode="sync")
+    finally:
+        flags.FLAGS._reset()
+
+
+def test_zero_flag_validation():
+    """Parse-time --zero validation: every unsupported composition names
+    the flags at the command line, not mid-trace."""
+    from distributed_tensorflow_tpu import flags
+
+    flags.define_reference_flags()
+    cases = [
+        (["--zero=2"], "level 2"),
+        (["--zero=5"], "must be 0"),
+        (["--zero=1", "--pipeline", "--model_axis=2", "--num_blocks=4"],
+         "pipeline"),
+        (["--zero=3", "--expert_parallel"], "model axis"),
+        (["--zero=1", "--seq_parallel"], "token axis"),
+        (["--zero=1", "--model_axis=2"], "tensor parallelism"),
+        (["--zero=1", "--mode=ps"], "SYNCHRONOUS"),
+        (["--zero=1", "--ps_hosts=a:1,b:2"], "SYNCHRONOUS"),
+        (["--zero=1", "--mode=local"], "no mesh"),
+    ]
+    try:
+        for args, match in cases:
+            flags.FLAGS._reset()
+            with pytest.raises(ValueError, match=match):
+                flags.FLAGS._parse(args)
+        # the supported surface parses clean
+        for ok in (["--zero=0"], ["--zero=1"], ["--zero=3"],
+                   ["--zero=1", "--device_data", "--clip_norm=1.0",
+                    "--accum_steps=2"]):
+            flags.FLAGS._reset()
+            flags.FLAGS._parse(ok)
+            assert flags.FLAGS.zero == int(ok[0].split("=")[1])
+    finally:
+        flags.FLAGS._reset()
+
+
+# ---------------------------------------------- memory budget
+
+
+@pytest.mark.parametrize("model_cls", [MLP, DeepCNN])
+def test_zero_memory_budget_reductions(model_cls):
+    """The acceptance pin: >= D-fold optimizer-state reduction at ZeRO-1
+    and >= D-fold param reduction at ZeRO-3 on the flagship models
+    (their leaves dwarf the padding and the replicated scalar ``t``)."""
+    d = 8
+    b = zero_memory_budget(model_cls(), adam(1e-3), d)
+    assert b["opt_reduction"] >= d * 0.99
+    assert b["param_reduction"] >= d * 0.99
+    per = b["per_chip"]
+    # replicated holds everything; zero1 keeps full params; zero3 chunks
+    assert per["zero1"]["params"] == per["replicated"]["params"]
+    assert per["zero1"]["opt"] < per["replicated"]["opt"]
+    assert per["zero3"]["params"] < per["replicated"]["params"]
+    # transient grad bytes are mode-independent (full backward output)
+    assert (per["replicated"]["grads"] == per["zero1"]["grads"]
+            == per["zero3"]["grads"] == b["param_bytes"])
+    for r in b["rows"]:
+        if r["chunked"]:
+            # padding never loses bytes: D chunks cover the leaf
+            assert r["sharded_bytes"] * d >= r["bytes"]
+        else:
+            assert r["sharded_bytes"] == r["bytes"]
+    # scalar slots (adam's t) replicate — never chunked
+    t_rows = [r for r in b["rows"] if r["leaf"] == "t"]
+    assert t_rows and not t_rows[0]["chunked"]
+
+
+def test_trace_ops_mem_mode():
+    """tools/trace_ops.py --mem prints the per-leaf table and the D-fold
+    reductions without a chip (the auditable-anywhere satellite)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "trace_ops.py"),
+         "--mem", "deep_cnn", "8"],
+        capture_output=True, text=True, timeout=300, cwd=root, env=env)
+    assert p.returncode == 0, p.stderr
+    assert "replicated" in p.stdout and "zero1" in p.stdout
+    assert "zero3" in p.stdout
+    assert "8.00x" in p.stdout  # both reductions on the flagship CNN
+    assert "weights/wd1" in p.stdout  # the per-leaf table
+    assert "reduce-scatter+all-gather" in p.stdout

@@ -18,9 +18,18 @@ chip required.
 --fault_spec grammar (utils/faults.py) — how the spec strings are
 discovered.
 
+``--mem MODEL D [--zero Z] [--optimizer OPT]`` prints the STATIC
+per-chip memory budget for ``MODEL`` sharded ``--zero``-style over a
+D-way data axis (parallel/zero.zero_memory_budget — jax.eval_shape, no
+chip, no compute): param/grad/optimizer bytes per leaf and the per-chip
+totals for replicated DP vs ZeRO-1 vs ZeRO-3, plus the per-step
+comm-volume comparison (all-reduce 2|G| vs reduce-scatter+all-gather
+|G|+|P|) — the D-fold saving auditable anywhere.
+
 Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
        python tools/trace_ops.py --schedule K M [V]
        python tools/trace_ops.py --faults
+       python tools/trace_ops.py --mem MODEL D [--zero Z] [--optimizer OPT]
 """
 
 from __future__ import annotations
@@ -98,6 +107,78 @@ def print_schedule(k_stages: int, microbatches: int,
           f"{sched.num_ticks * k_stages} x ({per_group} blocks each)")
 
 
+# --mem model configs: the flagship shapes the bench/tests exercise —
+# fixed here so the printout is reproducible without a flag parse
+_MEM_MODELS = {
+    "mlp": dict(image_size=28, channels=1, num_classes=10),
+    "deep_cnn": dict(image_size=28, channels=1, num_classes=10),
+    "resnet20": dict(image_size=32, channels=3, num_classes=10),
+    "lm": dict(vocab_size=32768, seq_len=1024, d_model=256, num_heads=4,
+               num_blocks=4),
+}
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit, div in (("GB", 2**30), ("MB", 2**20), ("KB", 2**10)):
+        if n >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"
+
+
+def print_mem(model_name: str, d: int, zero_level: int | None = None,
+              optimizer: str = "adam") -> None:
+    """Print the static per-chip memory budget (replicated vs ZeRO-1 vs
+    ZeRO-3 over a D-way data axis) for one of the flagship models — the
+    same ``zero_memory_budget`` accounting bench.py records, so what
+    prints here IS what the artifact reports. No chip, no compute
+    (``jax.eval_shape``)."""
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from distributed_tensorflow_tpu.models import get_model
+    from distributed_tensorflow_tpu.parallel.zero import zero_memory_budget
+    from distributed_tensorflow_tpu.training import get_optimizer
+
+    if model_name not in _MEM_MODELS:
+        raise SystemExit(f"--mem: unknown model {model_name!r}; "
+                         f"available: {sorted(_MEM_MODELS)}")
+    if zero_level is not None and zero_level not in (0, 1, 3):
+        raise SystemExit(f"--zero={zero_level} must be 0, 1 or 3")
+    model = get_model(model_name, **_MEM_MODELS[model_name])
+    budget = zero_memory_budget(model, get_optimizer(optimizer, 1e-3), d)
+
+    print(f"static per-chip memory budget — model={model_name} D={d} "
+          f"optimizer={optimizer} (jax.eval_shape; padding included)")
+    print(f"{'kind':<6} {'leaf':<44} {'elements':>10} {'bytes':>12} "
+          f"{'1/D bytes':>12}")
+    for r in budget["rows"]:
+        print(f"{r['kind']:<6} {r['leaf'][:44]:<44} {r['elements']:>10} "
+              f"{r['bytes']:>12} "
+              f"{r['sharded_bytes'] if r['chunked'] else r['bytes']:>12}")
+    print()
+    levels = ((0, "replicated"), (1, "zero1"), (3, "zero3"))
+    if zero_level is not None:
+        levels = tuple(lv for lv in levels if lv[0] == zero_level)
+    print(f"{'mode':<12} {'params/chip':>12} {'opt/chip':>12} "
+          f"{'grads/chip':>12} {'total/chip':>12}")
+    for _, key in levels:
+        pc = budget["per_chip"][key]
+        total = pc["params"] + pc["opt"] + pc["grads"]
+        print(f"{key:<12} {_fmt_bytes(pc['params']):>12} "
+              f"{_fmt_bytes(pc['opt']):>12} {_fmt_bytes(pc['grads']):>12} "
+              f"{_fmt_bytes(total):>12}")
+    print(f"\nopt-state reduction (zero1/zero3 vs replicated): "
+          f"{budget['opt_reduction']:.2f}x")
+    print(f"param reduction (zero3 vs replicated): "
+          f"{budget['param_reduction']:.2f}x")
+    g = budget["param_bytes"]  # grads mirror the param leaves
+    print(f"per-step comm volume: all-reduce 2|G| = {_fmt_bytes(2 * g)}; "
+          f"reduce-scatter+all-gather |G|+|P| = "
+          f"{_fmt_bytes(g + budget['param_bytes'])} "
+          f"(zero3 re-gathers params in forward/backward instead)")
+
+
 def print_faults() -> None:
     """List the fault-injection registry (the --fault_spec grammar's
     source of truth — utils/faults.INJECTION_POINTS)."""
@@ -134,5 +215,18 @@ if __name__ == "__main__":
         print_schedule(k, m, v)
     elif sys.argv[1] == "--faults":
         print_faults()
+    elif sys.argv[1] == "--mem":
+        rest = sys.argv[2:]
+        zero_level = None
+        optimizer = "adam"
+        if "--zero" in rest:
+            i = rest.index("--zero")
+            zero_level = int(rest[i + 1])
+            rest = rest[:i] + rest[i + 2:]
+        if "--optimizer" in rest:
+            i = rest.index("--optimizer")
+            optimizer = rest[i + 1]
+            rest = rest[:i] + rest[i + 2:]
+        print_mem(rest[0], int(rest[1]), zero_level, optimizer)
     else:
         main(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 25)
