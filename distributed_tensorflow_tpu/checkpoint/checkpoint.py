@@ -29,6 +29,7 @@ import numpy as np
 
 from distributed_tensorflow_tpu.utils.events import crc32c
 from distributed_tensorflow_tpu.utils.faults import fault_point
+from distributed_tensorflow_tpu.utils.telemetry import trace_span
 from distributed_tensorflow_tpu.utils.pytree import (
     _BF16_TAG,
     flatten_pytree,
@@ -174,13 +175,15 @@ def _write_flat(directory: str, flat: dict[str, np.ndarray], step: int,
     """The host-side half of a save: atomic npz write + index + GC of an
     already-fetched flat array dict (no device interaction — safe to run
     on a background thread)."""
-    os.makedirs(directory, exist_ok=True)
-    final = os.path.join(directory, f"{_PREFIX}-{step}.npz")
-    _atomic_npz(directory, final, {**flat, _MANIFEST: _manifest_entry(flat)})
-    fault_point("ckpt_write", path=final, step=step)
-    _write_index(directory, step)
-    _gc(directory, max_to_keep)
-    return final
+    with trace_span("ckpt_write", step=step):
+        os.makedirs(directory, exist_ok=True)
+        final = os.path.join(directory, f"{_PREFIX}-{step}.npz")
+        _atomic_npz(directory, final,
+                    {**flat, _MANIFEST: _manifest_entry(flat)})
+        fault_point("ckpt_write", path=final, step=step)
+        _write_index(directory, step)
+        _gc(directory, max_to_keep)
+        return final
 
 
 def _index_spec(index, shape) -> list:
@@ -273,11 +276,12 @@ def save_checkpoint_sharded(directory: str, state, step: int,
     suffix = f".{attempt}" if attempt else ""
     final = os.path.join(directory,
                          f"{_PREFIX}-{step}.shard{p}-of-{n}{suffix}.npz")
-    _atomic_npz(directory, final, arrays)
-    fault_point("ckpt_write", path=final, step=step)
-    if p == 0:
-        _write_index(directory, step)
-    _gc(directory, max_to_keep)
+    with trace_span("ckpt_write", step=step, shard=p):
+        _atomic_npz(directory, final, arrays)
+        fault_point("ckpt_write", path=final, step=step)
+        if p == 0:
+            _write_index(directory, step)
+        _gc(directory, max_to_keep)
     return final
 
 
@@ -711,6 +715,15 @@ def restore_with_fallback(directory: str, template, *,
     stored state (``template`` is then that field's template) — the
     integrity verification still covers the WHOLE file (a corrupt
     optimizer slot means the set is damaged, params included)."""
+    with trace_span("ckpt_restore", subtree=subtree or ""):
+        return _restore_with_fallback_impl(directory, template,
+                                           max_rescans=max_rescans,
+                                           subtree=subtree)
+
+
+def _restore_with_fallback_impl(directory: str, template, *,
+                                max_rescans: int = 3,
+                                subtree: str | None = None):
     t0 = time.monotonic()
     depth = 0
     rescans = 0

@@ -75,6 +75,7 @@ def prefetch_to_device(
 
     def _worker():
         from distributed_tensorflow_tpu.utils.faults import fault_point
+        from distributed_tensorflow_tpu.utils.telemetry import trace_span
 
         try:
             for count, batch in enumerate(it):
@@ -82,7 +83,11 @@ def prefetch_to_device(
                 # here must reach the consumer as that exception — not a
                 # hang and not a silent short epoch
                 fault_point("prefetch", count=count)
-                item = stage(batch) if stage_on_worker else batch
+                if stage_on_worker:
+                    with trace_span("prefetch_stage", count=count):
+                        item = stage(batch)
+                else:
+                    item = batch
                 if stop.is_set() or not _send(item):
                     return
             _send(_END)

@@ -47,6 +47,7 @@ def build_serving_stack(FLAGS):
         predict_group_key,
     )
     from distributed_tensorflow_tpu.training.loop import build_model_for
+    from distributed_tensorflow_tpu.utils import telemetry
     from distributed_tensorflow_tpu.utils.faults import configure_from_flags
     from distributed_tensorflow_tpu.utils.metrics import (
         MetricsLogger,
@@ -54,6 +55,12 @@ def build_serving_stack(FLAGS):
     )
 
     configure_from_flags(FLAGS)
+    # the serving engine registers with the telemetry spine too: spans
+    # (serve_batch/serve_reload/ckpt_restore), the flight recorder, and
+    # the optional --watchdog_s hang watchdog around batch execution.
+    # job_name="serve": a replica pointed at the trainer's live logdir
+    # must not collide with the trainer's spans/flightrec files
+    telemetry.configure_from_flags(FLAGS, job_name="serve")
     model = build_model_for(FLAGS, _dataset_meta(FLAGS))
 
     mesh = None

@@ -39,7 +39,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from distributed_tensorflow_tpu.utils import telemetry
 from distributed_tensorflow_tpu.utils.faults import fault_point
+from distributed_tensorflow_tpu.utils.telemetry import trace_span
 
 
 class RejectedError(RuntimeError):
@@ -289,8 +291,12 @@ class DynamicBatcher:
                     n_batch = self.stats.batches
                 fault_point("serve_batch", count=n_batch,
                             size=len(batch))
-                results = self._runner([r.payload for r in batch],
-                                       [r.opts for r in batch])
+                with trace_span("serve_batch", count=n_batch,
+                                size=len(batch)), \
+                        telemetry.armed("serve_batch", count=n_batch,
+                                        size=len(batch)):
+                    results = self._runner([r.payload for r in batch],
+                                           [r.opts for r in batch])
                 if len(results) != len(batch):
                     raise RuntimeError(
                         f"runner returned {len(results)} results for "

@@ -39,6 +39,7 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     restore_params_with_fallback,
 )
 from distributed_tensorflow_tpu.utils.faults import fault_point
+from distributed_tensorflow_tpu.utils.telemetry import trace_span
 
 
 class NoCheckpointError(FileNotFoundError):
@@ -253,6 +254,10 @@ class InferenceEngine:
         if found is None or found[1] <= self._step:
             return None
         path, step = found
+        with trace_span("serve_reload", step=step):
+            return self._reload(path, step)
+
+    def _reload(self, path: str, step: int) -> dict | None:
         t0 = time.monotonic()
         try:
             fault_point("serve_reload", path=path, step=step)

@@ -174,15 +174,25 @@ class ServingMetrics:
     def on_batch(self, batcher) -> None:
         if self.profiler is not None:
             self.profiler.on_batch()
-        if self.emit_every <= 0:  # 0 = scalars off (profiler still runs)
-            return
         # cadence on OUR call count, not stats.batches: the hook only
         # runs on success, and a failed batch on the modulo boundary
         # would silently skip a whole emission window
         with self._lock:
             self._calls += 1
-            if self._calls % self.emit_every:
-                return
+            calls = self._calls
+        # the span-sink flush must NOT depend on scalars being on
+        # (--serve_metrics_every=0): without a cadenced flush the
+        # tracer's pending buffer grows unbounded in a long-running
+        # replica and spans-<host>.jsonl stays empty until shutdown
+        flush_every = self.emit_every if self.emit_every > 0 else 50
+        if calls % flush_every == 0:
+            from distributed_tensorflow_tpu.utils import telemetry
+
+            telemetry.get_tracer().flush()
+        if self.emit_every <= 0:  # 0 = scalars off (profiler still runs)
+            return
+        if calls % self.emit_every:
+            return
         stats = batcher.stats.as_dict()
         n = stats["batches"]
         with self._lock:
@@ -205,6 +215,9 @@ class ServingMetrics:
             scalars.update(batcher.latency.summary(f"{p}latency_ms_"))
         if self.logger is not None:
             self.logger.scalars(n, scalars)
+            # the serving cadence is this logger's display step: push
+            # the buffered tails so a crash keeps the latest window
+            self.logger.flush()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -224,7 +237,10 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         srv: InferenceServer = self.server.serving  # type: ignore[attr-defined]
         if self.path == "/healthz":
-            self._send(200, {"ok": True, "step": srv.engine.step})
+            health = srv.healthz()
+            self._send(200 if health["ok"] else 503, health)
+        elif self.path == "/metrics":
+            self._send(200, srv.metrics())
         elif self.path == "/stats":
             self._send(200, srv.stats())
         else:
@@ -274,11 +290,66 @@ class InferenceServer:
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.serving = self  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
+        self._t0 = time.monotonic()
 
     @property
     def address(self) -> str:
         h, p = self.httpd.server_address[:2]
         return f"http://{h}:{p}"
+
+    def _batchers(self):
+        for name in ("predict", "generate"):
+            b = getattr(self.client, f"{name}_batcher")
+            if b is not None:
+                yield name, b
+
+    def healthz(self) -> dict:
+        """The per-replica health signal a router/load-balancer polls:
+        liveness (every configured batcher still has a worker), the
+        served params version, and the current backpressure headline.
+        ``ok: false`` maps to HTTP 503 so an upstream health check can
+        act without parsing."""
+        closed = [name for name, b in self._batchers() if b.closed]
+        depth = sum(b.stats.as_dict()["queue_depth"]
+                    for _, b in self._batchers())
+        return {"ok": not closed, "step": self.engine.step,
+                "params_step": self.engine.step,
+                "closed_batchers": closed,
+                "queue_depth": depth,
+                "uptime_s": round(time.monotonic() - self._t0, 3)}
+
+    def metrics(self) -> dict:
+        """The full serving-metrics JSON (the ServingMetrics counters +
+        histogram summaries, per batcher): admission/rejection/failure
+        counters, latency quantiles from one consistent histogram
+        snapshot, explicit backpressure state (queue depth vs limit,
+        saturation, closed), and the params-version/reload story the
+        continuous-deployment loop reads (params_step, reload counts,
+        last reload wall time and fallback depth)."""
+        eng = self.engine
+        out = {
+            "params_step": eng.step,
+            "reloads": eng.counters["reloads"],
+            "reload_failures": eng.counters["reload_failures"],
+            "reload_fallbacks": eng.counters["reload_fallbacks"],
+            "last_reload_ms": eng.counters["last_reload_ms"],
+            "last_fallback_depth": eng.counters["last_fallback_depth"],
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        }
+        for name, b in self._batchers():
+            stats = b.stats.as_dict()
+            entry = dict(stats)
+            if b.latency is not None:
+                entry["latency_ms"] = b.latency.summary()
+            entry["backpressure"] = {
+                "queue_depth": stats["queue_depth"],
+                "queue_limit": b.queue_depth,
+                "saturated": stats["queue_depth"] >= b.queue_depth,
+                "closed": b.closed,
+                "rejected_full": stats["rejected_full"],
+            }
+            out[name] = entry
+        return out
 
     def stats(self) -> dict:
         out = {"engine": self.engine.stats()}

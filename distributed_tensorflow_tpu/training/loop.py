@@ -19,6 +19,7 @@ same loop through a PS-backed step function.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -49,9 +50,12 @@ from distributed_tensorflow_tpu.training.supervisor import Supervisor
 from distributed_tensorflow_tpu.training.train_state import evaluate
 from distributed_tensorflow_tpu.utils import (
     MetricsLogger,
+    StepTimer,
     Throughput,
     collective_sync_cadence,
+    trace_span,
 )
+from distributed_tensorflow_tpu.utils import telemetry
 
 
 @dataclass
@@ -147,6 +151,11 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     from distributed_tensorflow_tpu.utils import faults
 
     faults.configure_from_flags(FLAGS)
+    # the telemetry spine registers this run: span sink + flight
+    # recorder under --logdir, optional --watchdog_s hang watchdog.
+    # Every loop variant below inherits it (the dispatched _train_*
+    # helpers run in this process)
+    telemetry.configure_from_flags(FLAGS)
     if int(getattr(FLAGS, "zero", 0) or 0) and mode != "sync":
         # fail BEFORE dataset/model setup: the parse-time validator can
         # only catch an EXPLICIT --mode=local/ps (--mode=auto resolves
@@ -629,35 +638,54 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         profile_done = not FLAGS.profile_dir
         compile_done = False
         sync_every = collective_sync_cadence(mode == "sync")
+        stimer = StepTimer()
         try:
             meter.reset()
             while not should_stop() and step < FLAGS.training_iter:
+                t0 = time.perf_counter()
                 batch = next(batches)
+                stimer.add("host_wait", time.perf_counter() - t0)
                 if step % FLAGS.display_step == 0:
-                    m = eval_fn(state.params, batch, state.model_state)
-                    last_display = {k: float(v) for k, v in m.items()}
+                    with trace_span("display_eval", step=step), \
+                            telemetry.armed("display_eval", step=step):
+                        m = eval_fn(state.params, batch, state.model_state)
+                        # the float() readback is where this actually blocks
+                        last_display = {k: float(v) for k, v in m.items()}
                     logger.log_display(step, last_display["loss"],
                                        last_display["accuracy"])
-                    logger.scalars(step, {"images_per_sec": meter.images_per_sec})
+                    logger.scalars(step, {"images_per_sec": meter.images_per_sec,
+                                          **stimer.scalars()})
+                    logger.flush()
+                    telemetry.get_tracer().flush()
                 if compile_done and not profile_done and not profiling:
                     jax.profiler.start_trace(FLAGS.profile_dir)
                     profiling = True
                     profile_stop_at = step + FLAGS.profile_steps
-                state, step_m = step_fn(state, batch)
+                t0 = time.perf_counter()
+                with trace_span("train_step", step=step), \
+                        telemetry.armed("train_step", step=step):
+                    state, step_m = step_fn(state, batch)
+                stimer.add("dispatch", time.perf_counter() - t0)
                 step += 1
                 meter.step()
+                stimer.steps()
                 if sync_every and step % sync_every == 0:
                     # block on the metrics too: their tiny pmeans can
                     # still be in flight after the params' all-reduce
                     # completes, and a next program's gloo ops
                     # interleaving with them crashes the TCP pair
                     # (multi-process CPU; see collective_sync_cadence)
-                    jax.block_until_ready((state.params, step_m))
+                    t0 = time.perf_counter()
+                    with trace_span("device_sync", step=step), \
+                            telemetry.armed("collective_sync", step=step):
+                        jax.block_until_ready((state.params, step_m))
+                    stimer.add("device", time.perf_counter() - t0)
                 if not compile_done:
                     # first step carries XLA compile; keep it out of the
                     # throughput window
                     jax.block_until_ready(state.params)
                     meter.reset()
+                    stimer.reset()  # compile stays out of the breakdown too
                     compile_done = True
                 if profiling and step >= profile_stop_at:
                     jax.block_until_ready(state.params)
@@ -870,15 +898,17 @@ def _periodic_test_eval(FLAGS, sv, model, ds, logger, full_eval=None):
                     # one-sided collective)
                     state_box["last"] = (step, None)
             return
-        if full_eval is not None:
-            # sharded SP eval on the live mesh state — no host fetch,
-            # no dense-twin forward (single-process SP path)
-            m = full_eval(state, split)
-        else:
-            params = fetch_pytree(state.params)
-            model_state = fetch_pytree(state.model_state)
-            m = evaluate(model, params, split, model_state=model_state,
-                         batch_size=_eval_batch_for(model, ds.meta))
+        with trace_span("periodic_eval", step=step), \
+                telemetry.armed("periodic_eval", step=step):
+            if full_eval is not None:
+                # sharded SP eval on the live mesh state — no host fetch,
+                # no dense-twin forward (single-process SP path)
+                m = full_eval(state, split)
+            else:
+                params = fetch_pytree(state.params)
+                model_state = fetch_pytree(state.model_state)
+                m = evaluate(model, params, split, model_state=model_state,
+                             batch_size=_eval_batch_for(model, ds.meta))
         if not use_validation:
             # end-of-run reuse is only sound when this WAS the test split;
             # chief and non-chief must gate identically or the final
@@ -998,10 +1028,13 @@ class _HostCoordinator:
         if boundary == self._boundary:
             return
         self._boundary = boundary
-        votes = self._allgather(self._np.asarray(
-            [self._sv.should_stop(), self._sv.checkpointer.cadence_due(),
-             secrets.randbits(31)],
-            self._np.int32))
+        with trace_span("coord_vote", step=step), \
+                telemetry.armed("coord_vote_allgather", step=step):
+            votes = self._allgather(self._np.asarray(
+                [self._sv.should_stop(),
+                 self._sv.checkpointer.cadence_due(),
+                 secrets.randbits(31)],
+                self._np.int32))
         votes = votes.reshape(-1, 3)
         if votes[:, 1].max():
             self._sv.checkpoint_coordinated(
@@ -1108,6 +1141,7 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
     eval_every = max(0, getattr(FLAGS, "eval_step", 0))
 
+    stimer = StepTimer()
     with sv.managed(state) as box:
         step = box.step
         _log_recovery(sv, logger, step)
@@ -1116,28 +1150,47 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
         compile_done = False
         meter.reset()
         while not sv.should_stop() and step < FLAGS.training_iter:
+            t0 = time.perf_counter()
             batch = ds.train.next_batch(FLAGS.batch_size)
-            pp_state, m = step_fn(pp_state, stage_batch_pp(mesh, batch))
+            staged = stage_batch_pp(mesh, batch)
+            stimer.add("host_wait", time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            with trace_span("pp_step", step=step), \
+                    telemetry.armed("pp_step", step=step):
+                pp_state, m = step_fn(pp_state, staged)
+            stimer.add("dispatch", time.perf_counter() - t0)
             step += 1
             meter.step(FLAGS.batch_size)
+            stimer.steps()
             if not compile_done:
                 jax.block_until_ready(pp_state.params)
                 meter.reset()
+                stimer.reset()  # compile stays out of the breakdown too
                 compile_done = True
             boundary = (step % FLAGS.display_step == 0
                         or (eval_every and step % eval_every == 0)
                         or sv.checkpointer.cadence_due())
             if boundary:
-                host = fetch_state_pp(pp_state, model,
-                                      k_stages=model_axis,
-                                      virtual_stages=vstages)
+                # the standard-layout fetch blocks on the step's device
+                # work — the PP host loop's one device-wait site (there
+                # is no cadenced block_until_ready here)
+                t0 = time.perf_counter()
+                with trace_span("boundary_fetch", step=step), \
+                        telemetry.armed("pp_boundary_fetch", step=step):
+                    host = fetch_state_pp(pp_state, model,
+                                          k_stages=model_axis,
+                                          virtual_stages=vstages)
+                stimer.add("device", time.perf_counter() - t0)
                 box.update(host, step)
                 if step % FLAGS.display_step == 0:
                     last_display = {k: float(v) for k, v in m.items()}
                     logger.log_display(step, last_display["loss"],
                                        last_display["accuracy"])
                     logger.scalars(
-                        step, {"images_per_sec": meter.images_per_sec})
+                        step, {"images_per_sec": meter.images_per_sec,
+                               **stimer.scalars()})
+                    logger.flush()
+                    telemetry.get_tracer().flush()
                 periodic_eval(host, step)
                 sv.maybe_checkpoint(host, step)
         jax.block_until_ready(pp_state.params)
@@ -1231,20 +1284,31 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
         host = box.state
         compile_done = False
         meter.reset()
+        stimer = StepTimer()
         while not sv.should_stop() and step < FLAGS.training_iter:
             # realign to display boundaries after a resume from an
             # arbitrary checkpointed step, then cap at the budget
             to_boundary = -step % FLAGS.display_step or chunk
             length = min(chunk, to_boundary, FLAGS.training_iter - step)
-            pp_state, m = run_chunk(pp_state, length)
+            t0 = time.perf_counter()
+            with trace_span("pp_chunk", step=step, length=length), \
+                    telemetry.armed("pp_chunk", step=step, length=length):
+                pp_state, m = run_chunk(pp_state, length)
+            stimer.add("dispatch", time.perf_counter() - t0)
             step += length
             meter.step(length * FLAGS.batch_size)
+            stimer.steps(length)
             chunks_done += 1
             if sync_every and chunks_done % max(1, sync_every // chunk) == 0:
-                jax.block_until_ready(pp_state.params)
+                t0 = time.perf_counter()
+                with trace_span("device_sync", step=step), \
+                        telemetry.armed("collective_sync", step=step):
+                    jax.block_until_ready(pp_state.params)
+                stimer.add("device", time.perf_counter() - t0)
             if not compile_done:
                 jax.block_until_ready(pp_state.params)
                 meter.reset()
+                stimer.reset()  # compile stays out of the breakdown too
                 compile_done = True
             # eval boundaries use CROSSING semantics — a chunk can jump
             # clean over `step % eval_every == 0` (chunks align to
@@ -1257,15 +1321,25 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                         or sv.checkpointer.cadence_due()
                         or step >= FLAGS.training_iter)
             if boundary:
-                host = fetch_state_pp(pp_state, model, k_stages=k_stages,
-                                      virtual_stages=vstages)
+                # the fetch blocks on the chunk's device work —
+                # attributed to the device column like the host PP loop
+                t0 = time.perf_counter()
+                with trace_span("boundary_fetch", step=step), \
+                        telemetry.armed("pp_boundary_fetch", step=step):
+                    host = fetch_state_pp(pp_state, model,
+                                          k_stages=k_stages,
+                                          virtual_stages=vstages)
+                stimer.add("device", time.perf_counter() - t0)
                 box.update(host, step)
                 if step % FLAGS.display_step == 0:
                     last_display = {k: float(v) for k, v in m.items()}
                     logger.log_display(step, last_display["loss"],
                                        last_display["accuracy"])
                     logger.scalars(
-                        step, {"images_per_sec": meter.images_per_sec})
+                        step, {"images_per_sec": meter.images_per_sec,
+                               **stimer.scalars()})
+                    logger.flush()
+                    telemetry.get_tracer().flush()
                 periodic_eval(host, step)
                 sv.maybe_checkpoint(host, step)
         jax.block_until_ready(pp_state.params)
@@ -1394,34 +1468,53 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
         compile_done = False
         profiling = False
         profile_done = not FLAGS.profile_dir
+        stimer = StepTimer()
         try:
             meter.reset()
             while not sv.should_stop() and step < FLAGS.training_iter:
+                t0 = time.perf_counter()
                 batch = next(batches)
+                stimer.add("host_wait", time.perf_counter() - t0)
                 if step % FLAGS.display_step == 0:
                     # reference display semantics: dropout-off eval of
                     # the upcoming batch before the update
                     # (MNISTDist.py:179-182) — level 3 gathers the
                     # param chunks inside the sharded eval step
-                    m = eval_fn(z_state.params, batch,
-                                z_state.model_state)
-                    last_display = {k: float(v) for k, v in m.items()}
+                    with trace_span("display_eval", step=step), \
+                            telemetry.armed("display_eval", step=step):
+                        m = eval_fn(z_state.params, batch,
+                                    z_state.model_state)
+                        # the float() readback is where this actually blocks
+                        last_display = {k: float(v) for k, v in m.items()}
                     logger.log_display(step, last_display["loss"],
                                        last_display["accuracy"])
                     logger.scalars(
-                        step, {"images_per_sec": meter.images_per_sec})
+                        step, {"images_per_sec": meter.images_per_sec,
+                               **stimer.scalars()})
+                    logger.flush()
+                    telemetry.get_tracer().flush()
                 if compile_done and not profile_done and not profiling:
                     jax.profiler.start_trace(FLAGS.profile_dir)
                     profiling = True
                     profile_stop_at = step + FLAGS.profile_steps
-                z_state, step_m = step_fn(z_state, batch)
+                t0 = time.perf_counter()
+                with trace_span("zero_step", step=step), \
+                        telemetry.armed("zero_step", step=step):
+                    z_state, step_m = step_fn(z_state, batch)
+                stimer.add("dispatch", time.perf_counter() - t0)
                 step += 1
                 meter.step()
+                stimer.steps()
                 if sync_every and step % sync_every == 0:
-                    jax.block_until_ready((z_state.params, step_m))
+                    t0 = time.perf_counter()
+                    with trace_span("device_sync", step=step), \
+                            telemetry.armed("collective_sync", step=step):
+                        jax.block_until_ready((z_state.params, step_m))
+                    stimer.add("device", time.perf_counter() - t0)
                 if not compile_done:
                     jax.block_until_ready(z_state.params)
                     meter.reset()
+                    stimer.reset()  # compile stays out of the breakdown too
                     compile_done = True
                 if profiling and step >= profile_stop_at:
                     jax.block_until_ready(z_state.params)
@@ -1433,7 +1526,10 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
                             or sv.checkpointer.cadence_due()
                             or step >= FLAGS.training_iter)
                 if boundary:
-                    host = fetch_state_zero(z_state, model, level)
+                    with trace_span("boundary_fetch", step=step), \
+                            telemetry.armed("zero_boundary_fetch",
+                                            step=step):
+                        host = fetch_state_zero(z_state, model, level)
                     box.update(host, step)
                     periodic_eval(host, step)
                     sv.maybe_checkpoint(host, step)
@@ -1530,20 +1626,30 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
         compile_done = False
         profiling = False
         profile_done = not FLAGS.profile_dir
+        stimer = StepTimer()
         meter.reset()
         while not sv.should_stop() and step < FLAGS.training_iter:
             if step % FLAGS.display_step == 0:
                 # reference display semantics, same as the DP device
                 # loop: dropout-off eval of a fresh host batch before
                 # training continues
+                t0 = time.perf_counter()
                 b = ds.train.next_batch(FLAGS.batch_size)
-                m = eval_fn(z_state.params, shard_batch(mesh, b),
-                            z_state.model_state)
-                last_display = {k: float(v) for k, v in m.items()}
+                staged = shard_batch(mesh, b)
+                stimer.add("host_wait", time.perf_counter() - t0)
+                with trace_span("display_eval", step=step), \
+                        telemetry.armed("display_eval", step=step):
+                    m = eval_fn(z_state.params, staged,
+                                z_state.model_state)
+                    # the float() readback is where this actually blocks
+                    last_display = {k: float(v) for k, v in m.items()}
                 logger.log_display(step, last_display["loss"],
                                    last_display["accuracy"])
                 logger.scalars(step,
-                               {"images_per_sec": meter.images_per_sec})
+                               {"images_per_sec": meter.images_per_sec,
+                                **stimer.scalars()})
+                logger.flush()
+                telemetry.get_tracer().flush()
             if compile_done and not profile_done and not profiling:
                 jax.profiler.start_trace(FLAGS.profile_dir)
                 profiling = True
@@ -1552,15 +1658,25 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
             # arbitrary checkpointed step, then cap at the budget
             to_boundary = -step % FLAGS.display_step or chunk
             length = min(chunk, to_boundary, FLAGS.training_iter - step)
-            z_state, train_m = run_chunk(z_state, length)
+            t0 = time.perf_counter()
+            with trace_span("zero_chunk", step=step, length=length), \
+                    telemetry.armed("zero_chunk", step=step, length=length):
+                z_state, train_m = run_chunk(z_state, length)
+            stimer.add("dispatch", time.perf_counter() - t0)
             step += length
             meter.step(length * FLAGS.batch_size)
+            stimer.steps(length)
             chunks_done += 1
             if sync_every and chunks_done % max(1, sync_every // chunk) == 0:
-                jax.block_until_ready((z_state.params, train_m))
+                t0 = time.perf_counter()
+                with trace_span("device_sync", step=step), \
+                        telemetry.armed("collective_sync", step=step):
+                    jax.block_until_ready((z_state.params, train_m))
+                stimer.add("device", time.perf_counter() - t0)
             if not compile_done:
                 jax.block_until_ready(z_state.params)
                 meter.reset()
+                stimer.reset()  # compile stays out of the breakdown too
                 compile_done = True
             if profiling and step >= profile_stop_at:
                 jax.block_until_ready(z_state.params)
@@ -1574,7 +1690,9 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                         or sv.checkpointer.cadence_due()
                         or step >= FLAGS.training_iter)
             if boundary:
-                host = fetch_state_zero(z_state, model, level)
+                with trace_span("boundary_fetch", step=step), \
+                        telemetry.armed("zero_boundary_fetch", step=step):
+                    host = fetch_state_zero(z_state, model, level)
                 box.update(host, step)
                 periodic_eval(host, step)
                 sv.maybe_checkpoint(host, step)
@@ -1714,6 +1832,7 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
         compile_done = False
         profiling = False
         profile_done = not FLAGS.profile_dir
+        stimer = StepTimer()
         meter.reset()
         while not should_stop() and step < FLAGS.training_iter:
             if step % FLAGS.display_step == 0:
@@ -1721,13 +1840,21 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                 # minibatch before training continues (MNISTDist.py:179-182).
                 # Multi-process: each host draws its SLICE of the global
                 # batch — stage() assembles slices into the global array
+                t0 = time.perf_counter()
                 b = ds.train.next_batch(local_batch_size(FLAGS.batch_size))
                 staged = stage(b) if stage is not None else jax.device_put(b)
-                m = eval_fn(state.params, staged, state.model_state)
-                last_display = {k: float(v) for k, v in m.items()}
+                stimer.add("host_wait", time.perf_counter() - t0)
+                with trace_span("display_eval", step=step), \
+                        telemetry.armed("display_eval", step=step):
+                    m = eval_fn(state.params, staged, state.model_state)
+                    # the float() readback is where this actually blocks
+                    last_display = {k: float(v) for k, v in m.items()}
                 logger.log_display(step, last_display["loss"],
                                    last_display["accuracy"])
-                logger.scalars(step, {"images_per_sec": meter.images_per_sec})
+                logger.scalars(step, {"images_per_sec": meter.images_per_sec,
+                                      **stimer.scalars()})
+                logger.flush()
+                telemetry.get_tracer().flush()
             if compile_done and not profile_done and not profiling:
                 jax.profiler.start_trace(FLAGS.profile_dir)
                 profiling = True
@@ -1736,18 +1863,29 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
             # checkpointed step, then cap at the remaining step budget
             to_boundary = -step % FLAGS.display_step or chunk
             length = min(chunk, to_boundary, FLAGS.training_iter - step)
-            state, train_m = run_chunk(state, length)
+            t0 = time.perf_counter()
+            with trace_span("device_chunk", step=step, length=length), \
+                    telemetry.armed("device_chunk", step=step,
+                                    length=length):
+                state, train_m = run_chunk(state, length)
+            stimer.add("dispatch", time.perf_counter() - t0)
             step += length
             meter.step(length * FLAGS.batch_size)
+            stimer.steps(length)
             chunks_done += 1
             if sync_every and chunks_done % max(1, sync_every // chunk) == 0:
                 # metrics included: their in-flight pmeans must not
                 # interleave with the next program's gloo ops (see
                 # collective_sync_cadence)
-                jax.block_until_ready((state.params, train_m))
+                t0 = time.perf_counter()
+                with trace_span("device_sync", step=step), \
+                        telemetry.armed("collective_sync", step=step):
+                    jax.block_until_ready((state.params, train_m))
+                stimer.add("device", time.perf_counter() - t0)
             if not compile_done:
                 jax.block_until_ready(state.params)
                 meter.reset()
+                stimer.reset()  # compile stays out of the breakdown too
                 compile_done = True
             if profiling and step >= profile_stop_at:
                 jax.block_until_ready(state.params)

@@ -3,10 +3,16 @@ from distributed_tensorflow_tpu.utils.profiling import (
     Throughput,
     collective_sync_cadence,
 )
+from distributed_tensorflow_tpu.utils.telemetry import (
+    StepTimer,
+    trace_span,
+)
 
 __all__ = [
     "MetricsLogger",
     "reference_log_line",
     "Throughput",
     "collective_sync_cadence",
+    "StepTimer",
+    "trace_span",
 ]

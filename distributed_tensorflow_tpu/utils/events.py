@@ -198,6 +198,10 @@ class EventFileWriter:
             self._write(_event(time.time(), step, scalars=clean))
             self._file.flush()
 
+    def flush(self) -> None:
+        if self._file is not None:
+            self._file.flush()
+
     def close(self) -> None:
         if self._file is not None:
             self._file.close()

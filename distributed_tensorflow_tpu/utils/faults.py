@@ -246,6 +246,16 @@ def fault_point(name: str, **ctx) -> None:
 
 def _fire(rule: FaultRule, name: str, ctx: dict) -> None:
     desc = f"injected fault at {name} (mode={rule.mode}, ctx={ctx})"
+    try:
+        # flight-recorder hook BEFORE the mode's effect: mode=crash is
+        # os._exit — no atexit, no excepthook — so this is the one
+        # chance to leave a postmortem (telemetry is stdlib-only; this
+        # module stays jax-free)
+        from distributed_tensorflow_tpu.utils import telemetry
+
+        telemetry.record_fault(name, rule.mode, ctx)
+    except Exception:  # noqa: BLE001 — telemetry never alters fault semantics
+        pass
     if rule.mode == "crash":
         print(f"{desc}: hard-exiting {FAULT_EXIT_CODE}", flush=True)
         os._exit(FAULT_EXIT_CODE)

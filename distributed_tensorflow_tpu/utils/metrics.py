@@ -35,7 +35,16 @@ def reference_log_line(job_name: str, task_index: int, step: int, loss, acc) -> 
 
 
 class MetricsLogger:
-    """Scalar logger: stdout (reference format) + JSONL + TB event file."""
+    """Scalar logger: stdout (reference format) + JSONL + TB event file.
+
+    Thread-safe: the serving metrics cadence (batcher worker threads)
+    and a training loop can share one logger — ``scalars`` serializes
+    the two sink writes under a lock so JSONL lines and event frames
+    never interleave. Every emission also rides the telemetry flight
+    ring, so a crash postmortem shows the last scalars next to the last
+    spans; ``flush()`` (called at the display cadence and from the
+    flight-recorder dump path) pushes both sinks' buffered tails to
+    disk so a crash doesn't lose them."""
 
     def __init__(self, logdir: str | None = None, job_name: str = "worker",
                  task_index: int = 0, filename: str = "metrics.jsonl"):
@@ -43,30 +52,56 @@ class MetricsLogger:
         self.task_index = task_index
         self._file = None
         self._events = None
+        self._lock = threading.Lock()
         if logdir:
             os.makedirs(logdir, exist_ok=True)
             self._file = open(os.path.join(logdir, filename), "a", buffering=1)
             self._events = EventFileWriter(logdir)
+            # flight-recorder dumps flush this logger's tails too
+            from distributed_tensorflow_tpu.utils import telemetry
+
+            telemetry.register_flush(self.flush)
 
     def log_display(self, step: int, loss, acc):
         print(reference_log_line(self.job_name, self.task_index, step, loss, acc))
         self.scalars(step, {"mini_batch_loss": float(loss), "training_accuracy": float(acc)})
 
     def scalars(self, step: int, values: dict):
-        if self._file is not None:
-            rec = {"step": int(step), "time": time.time(),
-                   "job": f"{self.job_name}/{self.task_index}", **values}
-            self._file.write(json.dumps(rec) + "\n")
-        if self._events is not None:
-            self._events.add_scalars(step, values)
+        from distributed_tensorflow_tpu.utils import telemetry
+
+        with self._lock:
+            if self._file is not None:
+                rec = {"step": int(step), "time": time.time(),
+                       "job": f"{self.job_name}/{self.task_index}", **values}
+                self._file.write(json.dumps(rec) + "\n")
+            if self._events is not None:
+                self._events.add_scalars(step, values)
+        telemetry.record_scalars(step, values)
+
+    def flush(self):
+        """Push both sinks' buffered tails to disk (the JSONL file is
+        line-buffered, the event writer flushes per frame — this covers
+        the residue plus any OS-level buffering before a crash)."""
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+            if self._events is not None:
+                self._events.flush()
 
     def close(self):
-        if self._file is not None:
-            self._file.close()
-            self._file = None
-        if self._events is not None:
-            self._events.close()
-            self._events = None
+        from distributed_tensorflow_tpu.utils import telemetry
+
+        # run teardown is the last guaranteed flush point: drain the
+        # span sink too (the final checkpoint's ckpt_write span lands
+        # after the last display-cadence flush)
+        telemetry.get_tracer().flush()
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            if self._events is not None:
+                self._events.close()
+                self._events = None
 
 
 class StreamingHistogram:
@@ -122,7 +157,8 @@ class StreamingHistogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def mean(self) -> float:
@@ -133,35 +169,51 @@ class StreamingHistogram:
         """Lower edge of bucket ``i`` (i >= 1; bucket 0 is underflow)."""
         return self._low * math.exp((i - 1) * self._log_growth)
 
+    def _snapshot(self) -> tuple:
+        """One-lock consistent copy of the full estimator state — the
+        quantiles, mean and count a reader derives from it can never
+        disagree with each other (a cadence read racing ``record`` used
+        to take the lock per quantile and read ``_count`` outside it)."""
+        with self._lock:
+            return (list(self._counts), self._count, self._sum,
+                    self._min, self._max)
+
+    def _quantile_from(self, counts, count, mn, mx, q: float) -> float:
+        if not count:
+            return 0.0
+        rank = q * count
+        seen = 0.0
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            if seen + c >= rank:
+                if i == 0:
+                    return mn
+                frac = min(max((rank - seen) / c, 0.0), 1.0)
+                lo = self._edge(i)
+                val = lo * math.exp(frac * self._log_growth)
+                return min(max(val, mn), mx)
+            seen += c
+        return mx
+
     def quantile(self, q: float) -> float:
         """Value at quantile ``q`` in [0, 1]; 0.0 when empty. Clamped to
         the observed min/max so sparse histograms don't over-report the
         bucket width."""
-        with self._lock:
-            if not self._count:
-                return 0.0
-            rank = q * self._count
-            seen = 0.0
-            for i, c in enumerate(self._counts):
-                if not c:
-                    continue
-                if seen + c >= rank:
-                    if i == 0:
-                        return self._min
-                    frac = min(max((rank - seen) / c, 0.0), 1.0)
-                    lo = self._edge(i)
-                    val = lo * math.exp(frac * self._log_growth)
-                    return min(max(val, self._min), self._max)
-                seen += c
-            return self._max
+        counts, count, _total, mn, mx = self._snapshot()
+        return self._quantile_from(counts, count, mn, mx, q)
 
     def summary(self, prefix: str = "") -> dict:
         """{prefix}p50/p90/p99/mean/count — the scalars dict the serving
-        metrics cadence hands to MetricsLogger/events."""
-        out = {f"{prefix}p{int(q * 100)}": self.quantile(q)
+        metrics cadence hands to MetricsLogger/events. Computed from ONE
+        locked snapshot: the count always agrees with the quantiles even
+        while handler threads record concurrently."""
+        counts, count, total, mn, mx = self._snapshot()
+        out = {f"{prefix}p{int(q * 100)}":
+               self._quantile_from(counts, count, mn, mx, q)
                for q in self.QUANTILES}
-        out[f"{prefix}mean"] = self.mean
-        out[f"{prefix}count"] = float(self._count)
+        out[f"{prefix}mean"] = total / count if count else 0.0
+        out[f"{prefix}count"] = float(count)
         return out
 
     def reset(self) -> None:

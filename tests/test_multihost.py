@@ -357,6 +357,22 @@ def test_crash_at_ckpt_write_relaunch_recovers_exact_trajectory(tmp_path):
     survivor = latest_checkpoint(os.path.join(run_dir, "logs"))
     assert survivor is not None and 0 < survivor[1] < 24, survivor
 
+    # r11 telemetry acceptance: the hard crash (os._exit — no atexit,
+    # no excepthook) still left a flight-recorder postmortem, and its
+    # last span is the injected ckpt_write fault marker
+    import json as _json
+
+    fr_path = os.path.join(run_dir, "logs", "flightrec-worker-0.jsonl")
+    assert os.path.exists(fr_path), os.listdir(
+        os.path.join(run_dir, "logs"))
+    fr_recs = [_json.loads(l)
+               for l in open(fr_path).read().splitlines()]
+    assert fr_recs and fr_recs[0]["kind"] == "meta", fr_recs[:1]
+    assert fr_recs[0]["reason"] == "fault:ckpt_write:crash"
+    fr_spans = [r for r in fr_recs if r.get("kind") == "span"]
+    assert fr_spans and fr_spans[-1]["name"] == "fault:ckpt_write", \
+        fr_spans[-3:]
+
     # --- phase 2: relaunch, non-chief first, through the init retry path
     port = _free_port()
     peer = _spawn_crash_worker(1, port, run_dir,

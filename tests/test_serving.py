@@ -508,7 +508,9 @@ def test_http_server_routes_and_backpressure(lm_ckpt):
 
         health = json.loads(urllib.request.urlopen(
             srv.address + "/healthz", timeout=10).read())
-        assert health == {"ok": True, "step": 10}
+        assert health["ok"] is True and health["step"] == 10
+        assert health["params_step"] == 10
+        assert health["closed_batchers"] == []
 
         toks = post("/v1/generate",
                     {"prompt": list(range(8)), "max_new_tokens": 4})

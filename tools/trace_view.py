@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Render a telemetry span file as a per-step text timeline, and export
+Chrome-trace JSON.
+
+Reads the JSONL the telemetry spine writes — ``<logdir>/spans-<host>.jsonl``
+(raw span records) or ``<logdir>/flightrec-<host>.jsonl`` (a crash
+postmortem: meta/scalars/note records are carried along, spans render) —
+no jax, no framework import beyond utils/telemetry.
+
+    python tools/trace_view.py /tmp/train_logs/spans-worker-0.jsonl
+    python tools/trace_view.py spans.jsonl --last 50
+    python tools/trace_view.py spans.jsonl --step 100 200   # step range
+    python tools/trace_view.py spans.jsonl --chrome trace.json
+        # then load trace.json in chrome://tracing or ui.perfetto.dev
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# sys.path[0] is tools/ when run as a script; the package root is one up
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from distributed_tensorflow_tpu.utils.telemetry import chrome_trace  # noqa: E402
+
+
+def load_records(path: str) -> list[dict]:
+    """Span records from a spans-*.jsonl or flightrec-*.jsonl file.
+    Flight-recorder events are enveloped ``{"kind": ..., ...}``; only
+    span events carry a timeline, the rest are dropped here (``--raw``
+    in a pager shows them)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            kind = rec.get("kind")
+            if kind is None and "name" in rec:  # raw span record
+                out.append(rec)
+            elif kind == "span":  # flight-recorder envelope
+                span = {k: v for k, v in rec.items()
+                        if k not in ("kind", "t")}
+                if "name" in span:
+                    out.append(span)
+    return out
+
+
+def render_timeline(records: list[dict], out=sys.stdout) -> None:
+    """Per-step text timeline: wall-clock offset from the first span,
+    duration, thread, nesting by depth, step/attr tags."""
+    if not records:
+        print("(no span records)", file=out)
+        return
+    t0 = min(float(r.get("ts", 0.0)) for r in records)
+    records = sorted(records, key=lambda r: float(r.get("ts", 0.0)))
+    last_step = object()
+    core = ("name", "ts", "dur_s", "tid", "thread", "depth", "instant")
+    for r in records:
+        step = r.get("step")
+        if step != last_step and step is not None:
+            print(f"--- step {step} ---", file=out)
+            last_step = step
+        off = float(r.get("ts", 0.0)) - t0
+        dur = float(r.get("dur_s", 0.0))
+        extras = {k: v for k, v in r.items() if k not in core
+                  and k != "step"}
+        mark = "!" if r.get("instant") else " "
+        print(f"{off:12.6f}s {mark}{dur * 1e3:10.3f}ms "
+              f"[{r.get('thread', '?')}] "
+              f"{'  ' * int(r.get('depth', 0))}{r.get('name', '?')}"
+              f"{'  ' + str(extras) if extras else ''}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render telemetry span JSONL as a text timeline / "
+                    "Chrome trace")
+    ap.add_argument("file", help="spans-<host>.jsonl or "
+                                 "flightrec-<host>.jsonl")
+    ap.add_argument("--last", type=int, default=0,
+                    help="only the newest N spans")
+    ap.add_argument("--step", type=int, nargs=2, metavar=("LO", "HI"),
+                    default=None,
+                    help="only spans whose step tag is in [LO, HI]")
+    ap.add_argument("--chrome", metavar="OUT.json", default=None,
+                    help="write Chrome-trace/Perfetto JSON and exit")
+    args = ap.parse_args(argv)
+
+    records = load_records(args.file)
+    if args.step is not None:
+        lo, hi = args.step
+        records = [r for r in records
+                   if isinstance(r.get("step"), int) and
+                   lo <= r["step"] <= hi]
+    if args.last:
+        records = sorted(records,
+                         key=lambda r: float(r.get("ts", 0.0)))[-args.last:]
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_trace(records), f)
+        print(f"wrote {len(records)} spans to {args.chrome} "
+              f"(load in chrome://tracing or https://ui.perfetto.dev)")
+        return 0
+    render_timeline(records)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
