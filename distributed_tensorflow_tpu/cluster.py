@@ -174,9 +174,21 @@ def maybe_initialize_distributed(cluster: ClusterSpec, task_index: int,
                 except Exception:  # noqa: BLE001
                     pass
 
-    _initialize_with_retry(
-        _init, retries=max(0, int(init_retries)),
-        backoff_s=float(init_backoff_s),
-        what=f"jax.distributed.initialize({coordinator})",
-        cleanup_fn=_cleanup)
+    import time
+
+    from distributed_tensorflow_tpu.utils import telemetry
+
+    with telemetry.trace_span("cluster_init", coordinator=coordinator,
+                              process=int(task_index)):
+        _initialize_with_retry(
+            _init, retries=max(0, int(init_retries)),
+            backoff_s=float(init_backoff_s),
+            what=f"jax.distributed.initialize({coordinator})",
+            cleanup_fn=_cleanup)
+    # every process leaves initialize() once the coordinator has all
+    # members — a coarse first clock anchor for the fleet timeline
+    # (refined by the coord_clock markers at every vote); rides the
+    # span ring + flight recorder even before a sink is configured
+    telemetry.get_tracer().record_instant(
+        "init_clock", process=int(task_index), mono=time.monotonic())
     return True

@@ -461,10 +461,45 @@ def define_reference_flags():
     DEFINE_integer("flightrec_events", 512, "Flight-recorder ring "
                    "length: how many recent spans/scalars/notes the "
                    "crash postmortem (flightrec-<host>.jsonl) holds")
+    DEFINE_boolean("mfu", True, "Efficiency accounting "
+                   "(utils/efficiency.py): emit mfu, "
+                   "model_flops_per_sec and goodput scalars next to "
+                   "images_per_sec at the display cadence in every "
+                   "training loop. The FLOPs budget is analytic "
+                   "(per-layer, no chip interaction); goodput charges "
+                   "restore/checkpoint/eval/compile stalls against the "
+                   "wall clock. =false drops the scalars entirely")
+    DEFINE_float("mfu_peak_flops", 0.0, "Per-chip peak FLOP/s the MFU "
+                 "denominator uses. 0 = auto: known TPU chips resolve "
+                 "from a spec table by device_kind; anything else runs "
+                 "a one-shot cached matmul calibration (achieved "
+                 "FLOP/s stands in for peak). Set explicitly when the "
+                 "auto answer is wrong for your part")
+    DEFINE_string("sentinel_action", "", "Training-health sentinels "
+                  "(utils/sentinel.py): '' (default) = unarmed; "
+                  "warn = report trips (loud print, sentinel:<kind> "
+                  "span, scalar, flight-recorder dump); snapshot = "
+                  "warn + an emergency checkpoint of the last "
+                  "known-good state through the verified-save path "
+                  "into <logdir>/sentinel/; abort = snapshot + raise "
+                  "so the run exits loudly. Checks run at the display "
+                  "cadence on the scalars the loop already computes — "
+                  "no extra device work")
+    DEFINE_string("sentinel_kinds", "nan,loss_spike,grad_explosion,"
+                  "throughput_collapse",
+                  "Comma-separated sentinel kinds to arm (subset of "
+                  "nan, loss_spike, grad_explosion, "
+                  "throughput_collapse)")
+    DEFINE_integer("sentinel_window", 32, "Rolling-history length (in "
+                   "display-cadence observations) behind the sentinel "
+                   "median/MAD baselines")
+    DEFINE_float("sentinel_threshold", 10.0, "MADs above the rolling "
+                 "median at which loss_spike/grad_explosion trip")
     FLAGS._register_validator(_validate_pipeline_flags)
     FLAGS._register_validator(_validate_zero_flags)
     FLAGS._register_validator(_validate_fault_spec)
     FLAGS._register_validator(_validate_telemetry_flags)
+    FLAGS._register_validator(_validate_efficiency_flags)
     define_serving_flags()
 
 
@@ -657,6 +692,46 @@ def _validate_telemetry_flags(values: dict):
         raise ValueError(f"--flightrec_events={fe} must be >= 1 (the "
                          f"crash postmortem needs at least one slot; "
                          f"use --telemetry=false to disable telemetry)")
+
+
+def _validate_efficiency_flags(values: dict):
+    """Parse-time validation of the --mfu_* / --sentinel_* surface (the
+    PR-2 _register_validator pattern): an unknown sentinel kind or
+    action, a sentinel armed under --telemetry=false (its spans/flight
+    dumps would be silently inert), or a nonsensical window/threshold/
+    peak surfaces at the command line, not mid-run."""
+    if float(values.get("mfu_peak_flops") or 0.0) < 0:
+        raise ValueError("--mfu_peak_flops must be >= 0 (0 = auto-detect)")
+    action = (values.get("sentinel_action") or "").strip()
+    if action:
+        from distributed_tensorflow_tpu.utils.sentinel import (
+            ACTIONS,
+            parse_kinds,
+        )
+
+        if action not in ACTIONS:
+            raise ValueError(
+                f"--sentinel_action={action!r} must be one of "
+                f"{', '.join(ACTIONS)} (or empty = unarmed)")
+        telemetry_flag = values.get("telemetry")
+        if telemetry_flag is not None and not telemetry_flag:
+            raise ValueError(
+                "--sentinel_action with --telemetry=false is silently "
+                "degraded (the sentinel's trip spans and flight-recorder "
+                "postmortems ride the telemetry spine) — drop "
+                "--sentinel_action or re-enable --telemetry")
+        try:
+            parse_kinds(values.get("sentinel_kinds") or "")
+        except ValueError as e:
+            raise ValueError(f"--sentinel_kinds: {e}") from None
+        if int(values.get("sentinel_window") or 0) < 4:
+            raise ValueError(
+                f"--sentinel_window={values.get('sentinel_window')} must "
+                f"be >= 4 (the rolling median needs history to judge "
+                f"against)")
+        if float(values.get("sentinel_threshold") or 0.0) <= 0:
+            raise ValueError("--sentinel_threshold must be > 0 (MADs "
+                             "above the rolling median)")
 
 
 def _validate_fault_spec(values: dict):

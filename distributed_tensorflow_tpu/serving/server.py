@@ -291,6 +291,17 @@ class InferenceServer:
         self.httpd.serving = self  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
         self._t0 = time.monotonic()
+        # replica-health accounting (r12): serving goodput (uptime not
+        # spent unhealthy) + per-batcher p99 trend and saturation
+        # streaks, integrated lazily at poll time — the fields ROADMAP
+        # item 2's front-end router reads to weight replicas. Poll-
+        # driven by design: the router's own cadence is the sampler.
+        self._health_lock = threading.Lock()
+        self._health_last_t = self._t0
+        self._health_was_ok = True
+        self._down_s = 0.0
+        self._p99_prev: dict[str, float] = {}
+        self._sat_streak: dict[str, int] = {}
 
     @property
     def address(self) -> str:
@@ -318,14 +329,67 @@ class InferenceServer:
                 "queue_depth": depth,
                 "uptime_s": round(time.monotonic() - self._t0, 3)}
 
+    def _goodput_uptime_pct(self) -> float:
+        """Serving goodput: percent of this replica's uptime NOT spent
+        in an unhealthy state (a closed batcher — the /healthz 503
+        condition). Integrated lazily: each poll attributes the time
+        since the previous poll to the state observed THEN, so a
+        replica that went down between polls is billed from the poll
+        that last saw it healthy."""
+        now = time.monotonic()
+        ok_now = not any(b.closed for _, b in self._batchers())
+        with self._health_lock:
+            dt = max(0.0, now - self._health_last_t)
+            if not self._health_was_ok:
+                self._down_s += dt
+            self._health_last_t = now
+            self._health_was_ok = ok_now
+            uptime = max(now - self._t0, 1e-9)
+            return round(100.0 * (1.0 - min(self._down_s / uptime, 1.0)),
+                         4)
+
+    def _health_block(self, name: str, stats: dict, b) -> dict:
+        """Per-batcher health trend for the router: current p99 vs the
+        previous poll's (rising/flat/falling at +-25%/-20%), and the
+        saturation streak (consecutive polls with the queue at its
+        limit — one hot poll is a blip, a streak is a shed signal)."""
+        p99 = (b.latency.quantile(0.99) if b.latency is not None
+               else None)
+        saturated = stats["queue_depth"] >= b.queue_depth
+        with self._health_lock:
+            prev = self._p99_prev.get(name)
+            if p99 is not None:
+                self._p99_prev[name] = p99
+            streak = (self._sat_streak.get(name, 0) + 1) if saturated \
+                else 0
+            self._sat_streak[name] = streak
+        if p99 is None or prev is None or prev <= 0:
+            trend = "flat"
+        elif p99 > prev * 1.25:
+            trend = "rising"
+        elif p99 < prev * 0.8:
+            trend = "falling"
+        else:
+            trend = "flat"
+        return {
+            "p99_ms": p99,
+            "p99_prev_ms": prev,
+            "p99_trend": trend,
+            "saturation_streak": streak,
+            "closed": b.closed,
+        }
+
     def metrics(self) -> dict:
         """The full serving-metrics JSON (the ServingMetrics counters +
         histogram summaries, per batcher): admission/rejection/failure
         counters, latency quantiles from one consistent histogram
         snapshot, explicit backpressure state (queue depth vs limit,
-        saturation, closed), and the params-version/reload story the
+        saturation, closed), the params-version/reload story the
         continuous-deployment loop reads (params_step, reload counts,
-        last reload wall time and fallback depth)."""
+        last reload wall time and fallback depth), and the r12
+        replica-health fields a front-end router consumes:
+        ``goodput_uptime_pct`` plus a per-batcher ``health`` block
+        (p99 trend between polls, saturation streak)."""
         eng = self.engine
         out = {
             "params_step": eng.step,
@@ -335,6 +399,7 @@ class InferenceServer:
             "last_reload_ms": eng.counters["last_reload_ms"],
             "last_fallback_depth": eng.counters["last_fallback_depth"],
             "uptime_s": round(time.monotonic() - self._t0, 3),
+            "goodput_uptime_pct": self._goodput_uptime_pct(),
         }
         for name, b in self._batchers():
             stats = b.stats.as_dict()
@@ -348,6 +413,7 @@ class InferenceServer:
                 "closed": b.closed,
                 "rejected_full": stats["rejected_full"],
             }
+            entry["health"] = self._health_block(name, stats, b)
             out[name] = entry
         return out
 

@@ -55,7 +55,7 @@ from distributed_tensorflow_tpu.utils import (
     collective_sync_cadence,
     trace_span,
 )
-from distributed_tensorflow_tpu.utils import telemetry
+from distributed_tensorflow_tpu.utils import efficiency, telemetry
 
 
 @dataclass
@@ -121,13 +121,14 @@ def build_model_for(FLAGS, meta: dict):
     )
 
 
-def _log_recovery(sv, logger, step: int) -> None:
+def _log_recovery(sv, logger, step: int, eff=None) -> None:
     """Recovery observability: where this run's state came from
     (restore source step, fallback depth, quarantine count, time-to-
     restore — sv.restore_report, written by the verified-restore ladder).
     Emitted once per run into metrics.jsonl + the event file; a fresh
     init logs restore_step=-1 so 'never restored' and 'restored step 0'
-    stay distinguishable."""
+    stay distinguishable. The restore stall is the goodput accounting's
+    first charge (``eff``)."""
     rep = getattr(sv, "restore_report", None)
     logger.scalars(step, {
         "recovery_restore_step": float(rep.step) if rep else -1.0,
@@ -135,6 +136,106 @@ def _log_recovery(sv, logger, step: int) -> None:
         "recovery_quarantined": float(len(rep.quarantined)) if rep else 0.0,
         "recovery_time_s": round(rep.time_s, 4) if rep else 0.0,
     })
+    if eff is not None and rep is not None:
+        eff.charge(rep.time_s, "restore")
+
+
+class _charged:
+    """Tiny timing context: book the body's wall time against the
+    efficiency meter's goodput ledger (no-op when accounting is off)."""
+
+    __slots__ = ("_eff", "_kind", "_t0")
+
+    def __init__(self, eff, kind: str):
+        self._eff = eff
+        self._kind = kind
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._eff is not None:
+            self._eff.charge(time.perf_counter() - self._t0, self._kind)
+        return False
+
+
+def _display_scalars(meter, stimer, eff) -> dict:
+    """The display-cadence scalar family every loop emits: throughput,
+    the step-time breakdown, and — when accounting is on — mfu /
+    model_flops_per_sec / goodput (utils/efficiency.py)."""
+    out = {"images_per_sec": meter.images_per_sec, **stimer.scalars()}
+    if eff is not None:
+        out.update(eff.scalars(meter.images_per_sec))
+    return out
+
+
+def _booked_stall(eff) -> float:
+    """The cumulative stall seconds the goodput ledger has booked —
+    handed to Sentinel.observe so known stalls (ckpt/eval/restore/
+    compile) never read as a throughput collapse."""
+    return eff.goodput.lost_s if eff is not None else 0.0
+
+
+def _sentinel_host_state(state):
+    """Host snapshot of the live device state for the sentinel's
+    last-good ledger. The DP/TP step functions DONATE their input
+    buffers, so a device reference held across steps is dead by the
+    time a trip wants it — the snapshot must be taken at the healthy
+    boundary. Only called when --sentinel_action needs snapshots
+    (Sentinel.wants_state), at the display cadence. Cross-host-sharded
+    state returns None (its fetch is a collective every process would
+    have to join; the cadenced checkpoints remain that case's recovery
+    path)."""
+    from distributed_tensorflow_tpu.utils.pytree import (
+        fetch_pytree,
+        needs_collective_fetch,
+    )
+
+    if needs_collective_fetch(state):
+        return None
+    return fetch_pytree(state)
+
+
+def _sentinel_for(FLAGS, sv, logger):
+    """Chief-side training-health sentinel (utils/sentinel.py), its
+    emergency-save callback wired to the verified-save path (the same
+    CRC-manifest writer every checkpoint uses) under
+    ``<logdir>/sentinel/`` — outside the main directory's GC, so a sick
+    run that keeps checkpointing garbage can never age the last-good
+    state out. None when unarmed (--sentinel_action default) or on
+    non-chief processes (the chief owns the display metrics)."""
+    import os
+
+    from distributed_tensorflow_tpu.utils import sentinel as _sentinel
+
+    if not sv.is_chief:
+        return None
+
+    def save_fn(state, step):
+        from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+            save_checkpoint,
+        )
+        from distributed_tensorflow_tpu.utils.pytree import (
+            needs_collective_fetch,
+        )
+
+        if needs_collective_fetch(state):
+            print("sentinel: state spans hosts — emergency snapshot "
+                  "skipped (the collective fetch needs every process at "
+                  "this boundary; the cadenced checkpoints remain the "
+                  "recovery path)")
+            return None
+        return save_checkpoint(os.path.join(FLAGS.logdir, "sentinel"),
+                               state, step, max_to_keep=2)
+
+    # abort: single-process raises (loud nonzero exit); multi-host must
+    # route through the supervisor's stop so the coordinated vote takes
+    # every process out at the same step instead of stranding peers in
+    # the next collective
+    stop_fn = sv.request_stop if jax.process_count() > 1 else None
+    return _sentinel.from_flags(FLAGS, save_fn=save_fn, logger=logger,
+                                stop_fn=stop_fn)
 
 
 def train(FLAGS, mode: str = "local") -> TrainResult:
@@ -610,17 +711,22 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                            job_name=FLAGS.job_name or "worker",
                            task_index=FLAGS.task_index)
     meter = Throughput(FLAGS.batch_size, n_chips)
+    stimer = StepTimer()
+    eff = efficiency.meter_from_flags(FLAGS, model, FLAGS.batch_size,
+                                      n_chips)
+    snt = _sentinel_for(FLAGS, sv, logger)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
-                                        full_eval=sp_full_eval)
+                                        full_eval=sp_full_eval, eff=eff)
 
-    coord = (_HostCoordinator(sv, coord_steps_from_flags(FLAGS))
+    coord = (_HostCoordinator(sv, coord_steps_from_flags(FLAGS),
+                              stimer=stimer, logger=logger)
              if (mode == "sync" and n_procs > 1) else None)
     should_stop = coord.should_stop if coord is not None else sv.should_stop
 
     with sv.managed(state) as box:
         state, step = box.state, box.step
-        _log_recovery(sv, logger, step)
+        _log_recovery(sv, logger, step, eff)
         periodic_eval.prime(step)
         if restage is not None:
             # a restored checkpoint arrives as host arrays; re-place it on
@@ -638,7 +744,6 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
         profile_done = not FLAGS.profile_dir
         compile_done = False
         sync_every = collective_sync_cadence(mode == "sync")
-        stimer = StepTimer()
         try:
             meter.reset()
             while not should_stop() and step < FLAGS.training_iter:
@@ -647,14 +752,20 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 stimer.add("host_wait", time.perf_counter() - t0)
                 if step % FLAGS.display_step == 0:
                     with trace_span("display_eval", step=step), \
-                            telemetry.armed("display_eval", step=step):
+                            telemetry.armed("display_eval", step=step), \
+                            _charged(eff, "eval"):
                         m = eval_fn(state.params, batch, state.model_state)
                         # the float() readback is where this actually blocks
                         last_display = {k: float(v) for k, v in m.items()}
+                    if snt is not None:
+                        snt.observe(step, last_display,
+                                    state=lambda: _sentinel_host_state(
+                                        state),
+                                    stall_s=_booked_stall(eff))
                     logger.log_display(step, last_display["loss"],
                                        last_display["accuracy"])
-                    logger.scalars(step, {"images_per_sec": meter.images_per_sec,
-                                          **stimer.scalars()})
+                    logger.scalars(step,
+                                   _display_scalars(meter, stimer, eff))
                     logger.flush()
                     telemetry.get_tracer().flush()
                 if compile_done and not profile_done and not profiling:
@@ -682,8 +793,15 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                     stimer.add("device", time.perf_counter() - t0)
                 if not compile_done:
                     # first step carries XLA compile; keep it out of the
-                    # throughput window
-                    jax.block_until_ready(state.params)
+                    # throughput window. Goodput must keep seeing it as
+                    # an init stall — and the compile happens INSIDE the
+                    # first dispatch call (jit traces+compiles
+                    # synchronously), so charge the pre-compile window's
+                    # accumulated work plus this block's wait
+                    if eff is not None:
+                        eff.charge(stimer.cumulative_work()[0], "init")
+                    with _charged(eff, "init"):
+                        jax.block_until_ready(state.params)
                     meter.reset()
                     stimer.reset()  # compile stays out of the breakdown too
                     compile_done = True
@@ -695,9 +813,13 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 periodic_eval(state, step)
                 box.update(state, step)
                 if coord is not None:
-                    coord.tick(state, step)
+                    # the vote allgather's wait is peer-coordination
+                    # stall (mostly skew), not checkpoint time
+                    with _charged(eff, "coord"):
+                        coord.tick(state, step)
                 else:
-                    sv.maybe_checkpoint(state, step)
+                    with _charged(eff, "ckpt"):
+                        sv.maybe_checkpoint(state, step)
             jax.block_until_ready(state.params)
         finally:
             if profiling:
@@ -848,7 +970,8 @@ def _eval_batch_for(model, meta: dict) -> int:
     return 1000
 
 
-def _periodic_test_eval(FLAGS, sv, model, ds, logger, full_eval=None):
+def _periodic_test_eval(FLAGS, sv, model, ds, logger, full_eval=None,
+                        eff=None):
     """(state, step) -> None: full held-out evaluation every
     ``--eval_step`` steps (crossing semantics, so chunked loops that jump
     several steps per dispatch still evaluate once per boundary). Chief
@@ -899,7 +1022,8 @@ def _periodic_test_eval(FLAGS, sv, model, ds, logger, full_eval=None):
                     state_box["last"] = (step, None)
             return
         with trace_span("periodic_eval", step=step), \
-                telemetry.armed("periodic_eval", step=step):
+                telemetry.armed("periodic_eval", step=step), \
+                _charged(eff, "eval"):
             if full_eval is not None:
                 # sharded SP eval on the live mesh state — no host fetch,
                 # no dense-twin forward (single-process SP path)
@@ -1001,7 +1125,7 @@ class _HostCoordinator:
     milliseconds of compute — and the final checkpoint still lands at the
     agreed exit step."""
 
-    def __init__(self, sv, every: int):
+    def __init__(self, sv, every: int, stimer=None, logger=None):
         import numpy as np
         from jax.experimental import multihost_utils
 
@@ -1011,35 +1135,82 @@ class _HostCoordinator:
         self._boundary = None
         self._np = np
         self._allgather = multihost_utils.process_allgather
+        # straggler attribution (r12): the vote carries each host's mean
+        # work-per-step (StepTimer.cumulative_work — host_wait+dispatch,
+        # the column a straggler burns while its peers wait in the
+        # collective); the chief turns the gathered column into the
+        # step_skew_s / straggler_host scalars. Rides the EXISTING
+        # allgather — no new sync points, two extra int32 per process.
+        self._stimer = stimer
+        self._logger = logger
+        self._last_work = (0.0, 0)
 
     def should_stop(self) -> bool:
         return self._stop
 
+    def _work_us_per_step(self) -> int:
+        if self._stimer is None:
+            return 0
+        work_s, steps = self._stimer.cumulative_work()
+        dw = work_s - self._last_work[0]
+        dn = steps - self._last_work[1]
+        self._last_work = (work_s, steps)
+        if dn <= 0:
+            return 0
+        return min(int(dw / dn * 1e6), 2 ** 31 - 1)
+
     def tick(self, state, step: int) -> None:
         """Call once per loop iteration, after ``step`` advanced. At each
-        boundary: one allgather of [stop?, chief-save-due?, token]; any
-        stop vote stops everyone, a save vote routes every process into
-        the coordinated checkpoint. The token column (random per
-        process, row 0's wins) is the sharded checkpoint's per-attempt
-        nonce — agreed HERE so the save itself stays collective-free."""
+        boundary: one allgather of [stop?, chief-save-due?, token,
+        work_us]; any stop vote stops everyone, a save vote routes every
+        process into the coordinated checkpoint. The token column
+        (random per process, row 0's wins) is the sharded checkpoint's
+        per-attempt nonce — agreed HERE so the save itself stays
+        collective-free. The work_us column is each host's mean
+        work-per-step since the last vote (straggler attribution); the
+        completed allgather is also the fleet's shared clock barrier —
+        every host drops a ``coord_clock`` marker right after it, which
+        tools/fleet_report.py uses to align the per-host span files
+        onto one timeline."""
         import secrets
 
         boundary = step // self._every
         if boundary == self._boundary:
             return
         self._boundary = boundary
+        work_us = self._work_us_per_step()
         with trace_span("coord_vote", step=step), \
                 telemetry.armed("coord_vote_allgather", step=step):
             votes = self._allgather(self._np.asarray(
                 [self._sv.should_stop(),
                  self._sv.checkpointer.cadence_due(),
-                 secrets.randbits(31)],
+                 secrets.randbits(31),
+                 work_us],
                 self._np.int32))
-        votes = votes.reshape(-1, 3)
+        # all hosts leave the allgather within network-jitter of each
+        # other: the wall/monotonic pair sampled HERE is the per-host
+        # clock-offset anchor (fleet_report matches boundary ids). The
+        # marker also carries this host's own work_us: a straggler's
+        # lost time hides in host_wait, which no per-step span covers —
+        # persisting the vote's numerator into the span stream is what
+        # lets the OFFLINE report attribute with the same precision as
+        # the live scalar.
+        telemetry.get_tracer().record_instant(
+            "coord_clock", boundary=int(boundary), step=int(step),
+            mono=time.monotonic(), work_us=int(work_us))
+        votes = votes.reshape(-1, 4)
         if votes[:, 1].max():
             self._sv.checkpoint_coordinated(
                 state, step, attempt=format(int(votes[0, 2]), "08x"))
         self._stop = bool(votes[:, 0].max())
+        if self._logger is not None and len(votes) > 1:
+            work = votes[:, 3]
+            if int(work.max()) > 0:
+                self._logger.scalars(step, {
+                    "step_skew_s": round(
+                        float(int(work.max()) - int(work.min())) / 1e6, 6),
+                    "straggler_host": float(int(work.argmax())),
+                })
 
 
 def _train_pipeline(FLAGS, ds, model, opt, state, mode,
@@ -1137,14 +1308,18 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
                            job_name=FLAGS.job_name or "worker",
                            task_index=FLAGS.task_index)
     meter = Throughput(FLAGS.batch_size, n_chips)
+    eff = efficiency.meter_from_flags(FLAGS, model, FLAGS.batch_size,
+                                      n_chips)
+    snt = _sentinel_for(FLAGS, sv, logger)
     last_display = {}
-    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
+    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
+                                        eff=eff)
     eval_every = max(0, getattr(FLAGS, "eval_step", 0))
 
     stimer = StepTimer()
     with sv.managed(state) as box:
         step = box.step
-        _log_recovery(sv, logger, step)
+        _log_recovery(sv, logger, step, eff)
         periodic_eval.prime(step)
         pp_state = shard_state_pp(box.state, mesh, virtual_stages=vstages)
         compile_done = False
@@ -1163,7 +1338,12 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
             meter.step(FLAGS.batch_size)
             stimer.steps()
             if not compile_done:
-                jax.block_until_ready(pp_state.params)
+                # the first dispatch carried the XLA compile: charge the
+                # pre-compile window's work + this wait as an init stall
+                if eff is not None:
+                    eff.charge(stimer.cumulative_work()[0], "init")
+                with _charged(eff, "init"):
+                    jax.block_until_ready(pp_state.params)
                 meter.reset()
                 stimer.reset()  # compile stays out of the breakdown too
                 compile_done = True
@@ -1176,7 +1356,8 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
                 # is no cadenced block_until_ready here)
                 t0 = time.perf_counter()
                 with trace_span("boundary_fetch", step=step), \
-                        telemetry.armed("pp_boundary_fetch", step=step):
+                        telemetry.armed("pp_boundary_fetch", step=step), \
+                        _charged(eff, "ckpt"):
                     host = fetch_state_pp(pp_state, model,
                                           k_stages=model_axis,
                                           virtual_stages=vstages)
@@ -1184,15 +1365,18 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
                 box.update(host, step)
                 if step % FLAGS.display_step == 0:
                     last_display = {k: float(v) for k, v in m.items()}
+                    if snt is not None:
+                        snt.observe(step, last_display, state=host,
+                                    stall_s=_booked_stall(eff))
                     logger.log_display(step, last_display["loss"],
                                        last_display["accuracy"])
-                    logger.scalars(
-                        step, {"images_per_sec": meter.images_per_sec,
-                               **stimer.scalars()})
+                    logger.scalars(step,
+                                   _display_scalars(meter, stimer, eff))
                     logger.flush()
                     telemetry.get_tracer().flush()
                 periodic_eval(host, step)
-                sv.maybe_checkpoint(host, step)
+                with _charged(eff, "ckpt"):
+                    sv.maybe_checkpoint(host, step)
         jax.block_until_ready(pp_state.params)
         host = fetch_state_pp(pp_state, model, k_stages=model_axis,
                               virtual_stages=vstages)
@@ -1270,15 +1454,19 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                            job_name=FLAGS.job_name or "worker",
                            task_index=FLAGS.task_index)
     meter = Throughput(FLAGS.batch_size, n_chips)
+    eff = efficiency.meter_from_flags(FLAGS, model, FLAGS.batch_size,
+                                      n_chips)
+    snt = _sentinel_for(FLAGS, sv, logger)
     last_display = {}
-    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
+    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
+                                        eff=eff)
     eval_every = max(0, getattr(FLAGS, "eval_step", 0))
     sync_every = collective_sync_cadence(True)
     chunks_done = 0
 
     with sv.managed(state) as box:
         step = box.step
-        _log_recovery(sv, logger, step)
+        _log_recovery(sv, logger, step, eff)
         periodic_eval.prime(step)
         pp_state = shard_state_pp(box.state, mesh, virtual_stages=vstages)
         host = box.state
@@ -1306,7 +1494,12 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                     jax.block_until_ready(pp_state.params)
                 stimer.add("device", time.perf_counter() - t0)
             if not compile_done:
-                jax.block_until_ready(pp_state.params)
+                # the first dispatch carried the XLA compile: charge the
+                # pre-compile window's work + this wait as an init stall
+                if eff is not None:
+                    eff.charge(stimer.cumulative_work()[0], "init")
+                with _charged(eff, "init"):
+                    jax.block_until_ready(pp_state.params)
                 meter.reset()
                 stimer.reset()  # compile stays out of the breakdown too
                 compile_done = True
@@ -1325,7 +1518,8 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                 # attributed to the device column like the host PP loop
                 t0 = time.perf_counter()
                 with trace_span("boundary_fetch", step=step), \
-                        telemetry.armed("pp_boundary_fetch", step=step):
+                        telemetry.armed("pp_boundary_fetch", step=step), \
+                        _charged(eff, "ckpt"):
                     host = fetch_state_pp(pp_state, model,
                                           k_stages=k_stages,
                                           virtual_stages=vstages)
@@ -1333,15 +1527,18 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                 box.update(host, step)
                 if step % FLAGS.display_step == 0:
                     last_display = {k: float(v) for k, v in m.items()}
+                    if snt is not None:
+                        snt.observe(step, last_display, state=host,
+                                    stall_s=_booked_stall(eff))
                     logger.log_display(step, last_display["loss"],
                                        last_display["accuracy"])
-                    logger.scalars(
-                        step, {"images_per_sec": meter.images_per_sec,
-                               **stimer.scalars()})
+                    logger.scalars(step,
+                                   _display_scalars(meter, stimer, eff))
                     logger.flush()
                     telemetry.get_tracer().flush()
                 periodic_eval(host, step)
-                sv.maybe_checkpoint(host, step)
+                with _charged(eff, "ckpt"):
+                    sv.maybe_checkpoint(host, step)
         jax.block_until_ready(pp_state.params)
         host = fetch_state_pp(pp_state, model, k_stages=k_stages,
                               virtual_stages=vstages)
@@ -1448,14 +1645,18 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
                            job_name=FLAGS.job_name or "worker",
                            task_index=FLAGS.task_index)
     meter = Throughput(FLAGS.batch_size, n_chips)
+    eff = efficiency.meter_from_flags(FLAGS, model, FLAGS.batch_size,
+                                      n_chips)
+    snt = _sentinel_for(FLAGS, sv, logger)
     last_display = {}
-    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
+    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
+                                        eff=eff)
     eval_every = max(0, getattr(FLAGS, "eval_step", 0))
     sync_every = collective_sync_cadence(True)
 
     with sv.managed(state) as box:
         step = box.step
-        _log_recovery(sv, logger, step)
+        _log_recovery(sv, logger, step, eff)
         periodic_eval.prime(step)
         z_state = shard_state_zero(box.state, mesh, level)
         host = box.state
@@ -1481,16 +1682,21 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
                     # (MNISTDist.py:179-182) — level 3 gathers the
                     # param chunks inside the sharded eval step
                     with trace_span("display_eval", step=step), \
-                            telemetry.armed("display_eval", step=step):
+                            telemetry.armed("display_eval", step=step), \
+                            _charged(eff, "eval"):
                         m = eval_fn(z_state.params, batch,
                                     z_state.model_state)
                         # the float() readback is where this actually blocks
                         last_display = {k: float(v) for k, v in m.items()}
+                    if snt is not None:
+                        # `host` is this displayed step's state in the
+                        # standard layout (fetched at the same boundary)
+                        snt.observe(step, last_display, state=host,
+                                    stall_s=_booked_stall(eff))
                     logger.log_display(step, last_display["loss"],
                                        last_display["accuracy"])
-                    logger.scalars(
-                        step, {"images_per_sec": meter.images_per_sec,
-                               **stimer.scalars()})
+                    logger.scalars(step,
+                                   _display_scalars(meter, stimer, eff))
                     logger.flush()
                     telemetry.get_tracer().flush()
                 if compile_done and not profile_done and not profiling:
@@ -1512,7 +1718,12 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
                         jax.block_until_ready((z_state.params, step_m))
                     stimer.add("device", time.perf_counter() - t0)
                 if not compile_done:
-                    jax.block_until_ready(z_state.params)
+                    # the first dispatch carried the XLA compile: charge
+                    # the pre-compile work + this wait as an init stall
+                    if eff is not None:
+                        eff.charge(stimer.cumulative_work()[0], "init")
+                    with _charged(eff, "init"):
+                        jax.block_until_ready(z_state.params)
                     meter.reset()
                     stimer.reset()  # compile stays out of the breakdown too
                     compile_done = True
@@ -1528,11 +1739,13 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
                 if boundary:
                     with trace_span("boundary_fetch", step=step), \
                             telemetry.armed("zero_boundary_fetch",
-                                            step=step):
+                                            step=step), \
+                            _charged(eff, "ckpt"):
                         host = fetch_state_zero(z_state, model, level)
-                    box.update(host, step)
+                        box.update(host, step)
                     periodic_eval(host, step)
-                    sv.maybe_checkpoint(host, step)
+                    with _charged(eff, "ckpt"):
+                        sv.maybe_checkpoint(host, step)
             jax.block_until_ready(z_state.params)
         finally:
             if profiling:
@@ -1611,15 +1824,19 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                            job_name=FLAGS.job_name or "worker",
                            task_index=FLAGS.task_index)
     meter = Throughput(FLAGS.batch_size, n_chips)
+    eff = efficiency.meter_from_flags(FLAGS, model, FLAGS.batch_size,
+                                      n_chips)
+    snt = _sentinel_for(FLAGS, sv, logger)
     last_display = {}
-    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
+    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
+                                        eff=eff)
     eval_every = max(0, getattr(FLAGS, "eval_step", 0))
     sync_every = collective_sync_cadence(True)
     chunks_done = 0
 
     with sv.managed(state) as box:
         step = box.step
-        _log_recovery(sv, logger, step)
+        _log_recovery(sv, logger, step, eff)
         periodic_eval.prime(step)
         z_state = shard_state_zero(box.state, mesh, level)
         host = box.state
@@ -1638,16 +1855,21 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                 staged = shard_batch(mesh, b)
                 stimer.add("host_wait", time.perf_counter() - t0)
                 with trace_span("display_eval", step=step), \
-                        telemetry.armed("display_eval", step=step):
+                        telemetry.armed("display_eval", step=step), \
+                        _charged(eff, "eval"):
                     m = eval_fn(z_state.params, staged,
                                 z_state.model_state)
                     # the float() readback is where this actually blocks
                     last_display = {k: float(v) for k, v in m.items()}
+                if snt is not None:
+                    # `host` is this displayed step's state in the
+                    # standard layout (fetched at the same boundary)
+                    snt.observe(step, last_display, state=host,
+                                stall_s=_booked_stall(eff))
                 logger.log_display(step, last_display["loss"],
                                    last_display["accuracy"])
                 logger.scalars(step,
-                               {"images_per_sec": meter.images_per_sec,
-                                **stimer.scalars()})
+                               _display_scalars(meter, stimer, eff))
                 logger.flush()
                 telemetry.get_tracer().flush()
             if compile_done and not profile_done and not profiling:
@@ -1674,7 +1896,12 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                     jax.block_until_ready((z_state.params, train_m))
                 stimer.add("device", time.perf_counter() - t0)
             if not compile_done:
-                jax.block_until_ready(z_state.params)
+                # the first dispatch carried the XLA compile: charge the
+                # pre-compile window's work + this wait as an init stall
+                if eff is not None:
+                    eff.charge(stimer.cumulative_work()[0], "init")
+                with _charged(eff, "init"):
+                    jax.block_until_ready(z_state.params)
                 meter.reset()
                 stimer.reset()  # compile stays out of the breakdown too
                 compile_done = True
@@ -1691,11 +1918,13 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                         or step >= FLAGS.training_iter)
             if boundary:
                 with trace_span("boundary_fetch", step=step), \
-                        telemetry.armed("zero_boundary_fetch", step=step):
+                        telemetry.armed("zero_boundary_fetch", step=step), \
+                        _charged(eff, "ckpt"):
                     host = fetch_state_zero(z_state, model, level)
                 box.update(host, step)
                 periodic_eval(host, step)
-                sv.maybe_checkpoint(host, step)
+                with _charged(eff, "ckpt"):
+                    sv.maybe_checkpoint(host, step)
         jax.block_until_ready(z_state.params)
         if profiling:
             jax.profiler.stop_trace()
@@ -1812,18 +2041,24 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                            job_name=FLAGS.job_name or "worker",
                            task_index=FLAGS.task_index)
     meter = Throughput(FLAGS.batch_size, n_chips)
+    eff = efficiency.meter_from_flags(FLAGS, model, FLAGS.batch_size,
+                                      n_chips)
+    snt = _sentinel_for(FLAGS, sv, logger)
     last_display = {}
-    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger)
+    periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
+                                        eff=eff)
     sync_every = collective_sync_cadence(mesh is not None)
     chunks_done = 0
+    stimer = StepTimer()
 
-    coord = (_HostCoordinator(sv, coord_steps_from_flags(FLAGS))
+    coord = (_HostCoordinator(sv, coord_steps_from_flags(FLAGS),
+                              stimer=stimer, logger=logger)
              if jax.process_count() > 1 else None)
     should_stop = coord.should_stop if coord is not None else sv.should_stop
 
     with sv.managed(state) as box:
         state, step = box.state, box.step
-        _log_recovery(sv, logger, step)
+        _log_recovery(sv, logger, step, eff)
         periodic_eval.prime(step)
         if restage is not None:
             # a restored checkpoint arrives as host arrays; re-place it on
@@ -1832,7 +2067,6 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
         compile_done = False
         profiling = False
         profile_done = not FLAGS.profile_dir
-        stimer = StepTimer()
         meter.reset()
         while not should_stop() and step < FLAGS.training_iter:
             if step % FLAGS.display_step == 0:
@@ -1845,14 +2079,18 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                 staged = stage(b) if stage is not None else jax.device_put(b)
                 stimer.add("host_wait", time.perf_counter() - t0)
                 with trace_span("display_eval", step=step), \
-                        telemetry.armed("display_eval", step=step):
+                        telemetry.armed("display_eval", step=step), \
+                        _charged(eff, "eval"):
                     m = eval_fn(state.params, staged, state.model_state)
                     # the float() readback is where this actually blocks
                     last_display = {k: float(v) for k, v in m.items()}
+                if snt is not None:
+                    snt.observe(step, last_display,
+                                state=lambda: _sentinel_host_state(state),
+                                stall_s=_booked_stall(eff))
                 logger.log_display(step, last_display["loss"],
                                    last_display["accuracy"])
-                logger.scalars(step, {"images_per_sec": meter.images_per_sec,
-                                      **stimer.scalars()})
+                logger.scalars(step, _display_scalars(meter, stimer, eff))
                 logger.flush()
                 telemetry.get_tracer().flush()
             if compile_done and not profile_done and not profiling:
@@ -1883,7 +2121,12 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                     jax.block_until_ready((state.params, train_m))
                 stimer.add("device", time.perf_counter() - t0)
             if not compile_done:
-                jax.block_until_ready(state.params)
+                # the first dispatch carried the XLA compile: charge the
+                # pre-compile window's work + this wait as an init stall
+                if eff is not None:
+                    eff.charge(stimer.cumulative_work()[0], "init")
+                with _charged(eff, "init"):
+                    jax.block_until_ready(state.params)
                 meter.reset()
                 stimer.reset()  # compile stays out of the breakdown too
                 compile_done = True
@@ -1895,9 +2138,13 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
             periodic_eval(state, step)
             box.update(state, step)
             if coord is not None:
-                coord.tick(state, step)
+                # the vote allgather's wait is peer-coordination stall
+                # (mostly skew), not checkpoint time — label it apart
+                with _charged(eff, "coord"):
+                    coord.tick(state, step)
             else:
-                sv.maybe_checkpoint(state, step)
+                with _charged(eff, "ckpt"):
+                    sv.maybe_checkpoint(state, step)
         jax.block_until_ready(state.params)
         if profiling:
             jax.profiler.stop_trace()

@@ -3,6 +3,12 @@ from distributed_tensorflow_tpu.utils.profiling import (
     Throughput,
     collective_sync_cadence,
 )
+from distributed_tensorflow_tpu.utils.efficiency import (
+    EfficiencyMeter,
+    GoodputMeter,
+    flops_budget,
+)
+from distributed_tensorflow_tpu.utils.sentinel import Sentinel, SentinelTripped
 from distributed_tensorflow_tpu.utils.telemetry import (
     StepTimer,
     trace_span,
@@ -15,4 +21,9 @@ __all__ = [
     "collective_sync_cadence",
     "StepTimer",
     "trace_span",
+    "EfficiencyMeter",
+    "GoodputMeter",
+    "flops_budget",
+    "Sentinel",
+    "SentinelTripped",
 ]

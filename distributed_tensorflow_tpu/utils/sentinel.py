@@ -1,0 +1,343 @@
+"""Training-health sentinels: catch a silently-dying run at the moment
+it starts dying.
+
+Today a NaN loss or a 100x spike just scrolls past in metrics.jsonl and
+the run burns its remaining budget on garbage. The sentinel watches the
+scalars the loops ALREADY compute at the display cadence (no extra
+device work, no new sync points) and trips on four kinds:
+
+- ``nan``                 — non-finite loss / grad norm / any observed metric
+- ``loss_spike``          — loss above rolling median + K x MAD
+- ``grad_explosion``      — grad norm above rolling median + K x MAD
+                            (checked when the loop's metrics carry a
+                            ``grad_norm``/``global_grad_norm`` key)
+- ``throughput_collapse`` — observed steps/sec below 20% of its rolling
+                            median (self-clocked between observations)
+
+The action ladder (``--sentinel_action``):
+
+- ``warn``     — loud print, a ``sentinel:<kind>`` instant span, a
+                 ``sentinel_trips`` scalar, and a flight-recorder dump
+                 (the postmortem shows the seconds AROUND the trip).
+- ``snapshot`` — all of warn, plus an EMERGENCY CHECKPOINT of the last
+                 known-good state through the verified-save path (the
+                 CRC-manifest writer every checkpoint uses) into
+                 ``<logdir>/sentinel/`` — outside the main directory's
+                 GC, so the last-good state is never lost even if the
+                 sick run keeps checkpointing garbage over the ladder's
+                 fallback depth.
+- ``abort``    — all of snapshot, then raise ``SentinelTripped`` so the
+                 run exits loudly (the orchestrator decides what's next;
+                 the emergency checkpoint holds the resume point).
+
+"Last known-good" is the newest state observed with finite metrics:
+the loops hand ``observe`` their current host-layout state at every
+display boundary, and the sentinel only adopts it when that
+observation's metrics are finite — so a NaN trip snapshots the state
+from the boundary BEFORE the poison, not the poisoned one.
+
+Trip detection is rolling-median + MAD (robust to the noisy early
+loss curve a mean/stddev would chase); the MAD is floored so a
+perfectly-flat loss can't make an epsilon wiggle trip. Each kind holds
+a cooldown after tripping so one incident reports once, not once per
+display window.
+
+stdlib-only (like utils/telemetry, which it reports through) so the
+flags validator can name unknown kinds at the command line without
+importing jax.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from statistics import median as _median
+
+KINDS = ("nan", "loss_spike", "grad_explosion", "throughput_collapse")
+ACTIONS = ("warn", "snapshot", "abort")
+
+GRAD_NORM_KEYS = ("grad_norm", "global_grad_norm")
+
+DEFAULT_WINDOW = 32
+DEFAULT_THRESHOLD = 10.0    # MADs above the rolling median
+COLLAPSE_FRACTION = 0.2     # throughput below this x median trips
+MIN_HISTORY = 8             # observations before spike/collapse can judge
+COOLDOWN_OBSERVATIONS = 4   # per-kind quiet period after a trip
+
+
+class SentinelTripped(RuntimeError):
+    """Raised by ``--sentinel_action=abort`` after the report +
+    emergency checkpoint; carries the trip for the caller/orchestrator."""
+
+    def __init__(self, report: "TripReport"):
+        super().__init__(
+            f"training-health sentinel tripped: {report.kind} at step "
+            f"{report.step} ({report.detail})"
+            + (f"; emergency checkpoint: {report.checkpoint_path}"
+               if report.checkpoint_path else ""))
+        self.report = report
+
+
+@dataclass
+class TripReport:
+    kind: str
+    step: int
+    value: float
+    detail: str
+    action: str
+    checkpoint_path: str | None = None
+
+
+def _median_mad(values: list[float]) -> tuple[float, float]:
+    med = _median(values)
+    mad = _median([abs(v - med) for v in values])
+    # floor: a flat history has MAD ~0 and any wiggle would trip
+    return med, max(mad, 1e-3 * max(abs(med), 1.0), 1e-12)
+
+
+def parse_kinds(spec: str) -> tuple[str, ...]:
+    """``--sentinel_kinds`` csv -> kinds tuple; unknown kinds raise with
+    the registry named (this backs the parse-time flag validator)."""
+    kinds = tuple(k.strip() for k in (spec or "").split(",") if k.strip())
+    unknown = [k for k in kinds if k not in KINDS]
+    if unknown:
+        raise ValueError(
+            f"unknown sentinel kind(s) {unknown} — known kinds: "
+            f"{', '.join(KINDS)}")
+    return kinds or KINDS
+
+
+class Sentinel:
+    """One per training run; ``observe(step, metrics, state=...)`` at
+    every display boundary. Returns the trips it fired (empty on a
+    healthy observation); ``--sentinel_action=abort`` raises
+    ``SentinelTripped`` after reporting."""
+
+    def __init__(self, kinds=KINDS, action: str = "warn", *,
+                 window: int = DEFAULT_WINDOW,
+                 threshold: float = DEFAULT_THRESHOLD,
+                 collapse_fraction: float = COLLAPSE_FRACTION,
+                 min_history: int = MIN_HISTORY,
+                 cooldown: int = COOLDOWN_OBSERVATIONS,
+                 save_fn=None, logger=None, stop_fn=None,
+                 time_fn=time.monotonic):
+        if action not in ACTIONS:
+            raise ValueError(f"sentinel action must be one of {ACTIONS}, "
+                             f"got {action!r}")
+        self.kinds = parse_kinds(",".join(kinds)) if kinds else KINDS
+        self.action = action
+        self.window = max(4, int(window))
+        self.threshold = float(threshold)
+        self.collapse_fraction = float(collapse_fraction)
+        # a history can never grow past the window: cap the judging
+        # threshold there too, or a small --sentinel_window would
+        # silently disable every history-based kind (len >= min_history
+        # would be unreachable)
+        self.min_history = max(2, min(int(min_history), self.window))
+        self.cooldown = max(0, int(cooldown))
+        self._save_fn = save_fn  # (state, step) -> checkpoint path
+        # abort's exit route: None = raise SentinelTripped (the loud
+        # single-process exit). Multi-host loops pass the supervisor's
+        # request_stop instead: a raise on the chief alone would strand
+        # the peers in their next collective (the silent-hang class the
+        # watchdog exists for) — the stop must travel through the
+        # coordinated vote so every host leaves at the same step.
+        self._stop_fn = stop_fn
+        self._logger = logger
+        self._time = time_fn
+        self._losses: list[float] = []
+        self._grads: list[float] = []
+        self._rates: list[float] = []
+        self._last_obs: tuple | None = None  # (step, t, stall_s)
+        self._cooldowns: dict[str, int] = {}
+        self._last_good: tuple | None = None  # (state, step)
+        self._saved_steps: set[int] = set()
+        self.trips: list[TripReport] = []
+
+    # ------------------------------------------------------------ core
+
+    @property
+    def wants_state(self) -> bool:
+        """True when observations should carry the state (the action
+        ladder will need a last-good snapshot to checkpoint). ``warn``
+        never touches the state, so loops can skip producing it."""
+        return self._save_fn is not None and self.action in ("snapshot",
+                                                             "abort")
+
+    def observe(self, step: int, metrics: dict | None = None,
+                state=None, stall_s: float = 0.0) -> list[TripReport]:
+        """``state`` may be the host-layout state itself or a ZERO-ARG
+        CALLABLE producing it — called only when this observation is
+        healthy and the action ladder needs snapshots. Loops whose live
+        state is device-resident with donated buffers (the DP/TP chunk
+        steps) MUST pass a callable that fetches to host: a device
+        reference is dead by the time a later trip wants it.
+
+        ``stall_s`` is the loop's CUMULATIVE booked stall time (the
+        goodput ledger's lost seconds): the throughput-collapse clock
+        subtracts the delta since the previous observation, so a known
+        stall — a slow checkpoint write, a long periodic eval, the
+        restore — can never read as a collapse (and, under
+        action=abort, kill a healthy run)."""
+        metrics = metrics or {}
+        now = self._time()
+        for k in list(self._cooldowns):
+            self._cooldowns[k] -= 1
+            if self._cooldowns[k] <= 0:
+                del self._cooldowns[k]
+
+        loss = metrics.get("loss")
+        grad = next((metrics[k] for k in GRAD_NORM_KEYS if k in metrics),
+                    None)
+        rate = None
+        if self._last_obs is not None:
+            prev_step, prev_t, prev_stall = self._last_obs
+            # booked stalls (ckpt/eval/restore) don't count against the
+            # throughput clock — only unexplained slowness should trip
+            dt = (now - prev_t) - max(0.0, float(stall_s) - prev_stall)
+            if dt > 0 and step > prev_step:
+                rate = (step - prev_step) / dt
+        self._last_obs = (step, now, float(stall_s))
+
+        tripped: list[TripReport] = []
+
+        def fire(kind, value, detail):
+            if kind in self.kinds and kind not in self._cooldowns:
+                self._cooldowns[kind] = self.cooldown
+                tripped.append(self._fire(kind, step, value, detail))
+
+        finite = all(
+            v is None or (isinstance(v, bool))
+            or (isinstance(v, (int, float)) and math.isfinite(float(v)))
+            for v in [loss, grad, *metrics.values()])
+        if not finite:
+            bad = sorted(k for k, v in metrics.items()
+                         if isinstance(v, (int, float))
+                         and not isinstance(v, bool)
+                         and not math.isfinite(float(v)))
+            fire("nan", float("nan"),
+                 f"non-finite metric(s): {', '.join(bad) or 'loss'}")
+        else:
+            if loss is not None and len(self._losses) >= self.min_history:
+                med, mad = _median_mad(self._losses)
+                if float(loss) > med + self.threshold * mad:
+                    fire("loss_spike", float(loss),
+                         f"loss {float(loss):.6g} > rolling median "
+                         f"{med:.6g} + {self.threshold:g} x MAD {mad:.3g}")
+            if grad is not None and len(self._grads) >= self.min_history:
+                med, mad = _median_mad(self._grads)
+                if float(grad) > med + self.threshold * mad:
+                    fire("grad_explosion", float(grad),
+                         f"grad norm {float(grad):.6g} > rolling median "
+                         f"{med:.6g} + {self.threshold:g} x MAD {mad:.3g}")
+            if rate is not None and len(self._rates) >= self.min_history:
+                med = _median(self._rates)
+                if med > 0 and rate < self.collapse_fraction * med:
+                    fire("throughput_collapse", rate,
+                         f"{rate:.3g} steps/s < "
+                         f"{self.collapse_fraction:g} x rolling median "
+                         f"{med:.3g}")
+            # healthy observation: extend the histories and adopt the
+            # state as last-known-good (a spike/collapse observation
+            # still extends history — the state math is fine — but a
+            # non-finite one must poison neither)
+            if loss is not None:
+                self._push(self._losses, float(loss))
+            if grad is not None:
+                self._push(self._grads, float(grad))
+            if rate is not None:
+                self._push(self._rates, rate)
+            if state is not None and not tripped and self.wants_state:
+                if callable(state):
+                    state = state()
+                if state is not None:
+                    self._last_good = (state, int(step))
+
+        if tripped and self.action == "abort":
+            if self._stop_fn is not None:
+                print(f"SENTINEL[abort]: coordinated stop requested "
+                      f"(multi-host run: every process must leave the "
+                      f"loop at the same voted step; the run ends at "
+                      f"the next coordination boundary)", flush=True)
+                self._stop_fn()
+            else:
+                raise SentinelTripped(tripped[0])
+        return tripped
+
+    def _push(self, hist: list[float], v: float) -> None:
+        hist.append(v)
+        if len(hist) > self.window:
+            del hist[0]
+
+    @property
+    def last_good_step(self) -> int | None:
+        return self._last_good[1] if self._last_good else None
+
+    # ---------------------------------------------------------- firing
+
+    def _fire(self, kind: str, step: int, value: float,
+              detail: str) -> TripReport:
+        from distributed_tensorflow_tpu.utils import telemetry
+
+        report = TripReport(kind=kind, step=int(step), value=value,
+                            detail=detail, action=self.action)
+        self.trips.append(report)
+        print(f"SENTINEL[{kind}] tripped at step {step}: {detail} "
+              f"(action={self.action})", flush=True)
+        telemetry.get_tracer().record_instant(
+            f"sentinel:{kind}", step=int(step), value=value,
+            detail=detail, action=self.action)
+        if self._logger is not None:
+            self._logger.scalars(int(step), {
+                "sentinel_trips": float(len(self.trips)),
+                f"sentinel_{kind}": 1.0,
+            })
+        if self.action in ("snapshot", "abort"):
+            report.checkpoint_path = self._emergency_checkpoint()
+        # dump AFTER the emergency save so the postmortem records its
+        # ckpt_write span (and the save itself rides the flight ring)
+        telemetry.flight_recorder().dump(f"sentinel:{kind}")
+        return report
+
+    def _emergency_checkpoint(self) -> str | None:
+        if self._save_fn is None:
+            return None
+        if self._last_good is None:
+            print("sentinel: no known-good state observed yet — nothing "
+                  "to snapshot", flush=True)
+            return None
+        state, step = self._last_good
+        if step in self._saved_steps:  # an ongoing incident re-trips on
+            return None                # the cooldown; save once per state
+        try:
+            path = self._save_fn(state, step)
+            self._saved_steps.add(step)
+            print(f"sentinel: emergency checkpoint of last-good step "
+                  f"{step} -> {path}", flush=True)
+            return path
+        except Exception as e:  # noqa: BLE001 — the report must still land
+            print(f"sentinel: emergency checkpoint failed: "
+                  f"{type(e).__name__}: {e}", flush=True)
+            return None
+
+
+def from_flags(FLAGS, *, save_fn=None, logger=None,
+               stop_fn=None) -> Sentinel | None:
+    """The one flag->feature mapping for the ``--sentinel_*`` surface,
+    shared by every training loop. None when unarmed (the default) or
+    when telemetry is off (the parse-time validator rejects that combo
+    at the CLI; non-CLI callers get the same quiet no-op)."""
+    action = (getattr(FLAGS, "sentinel_action", "") or "").strip()
+    if not action:
+        return None
+    if not bool(getattr(FLAGS, "telemetry", True)):
+        return None
+    return Sentinel(
+        parse_kinds(getattr(FLAGS, "sentinel_kinds", "") or ""),
+        action,
+        window=int(getattr(FLAGS, "sentinel_window", DEFAULT_WINDOW)
+                   or DEFAULT_WINDOW),
+        threshold=float(getattr(FLAGS, "sentinel_threshold",
+                                DEFAULT_THRESHOLD) or DEFAULT_THRESHOLD),
+        save_fn=save_fn, logger=logger, stop_fn=stop_fn)

@@ -259,7 +259,13 @@ class StepTimer:
     the breakdown adds no sync points; on backends with cadence 0 the
     device column reads 0 and the dispatch column absorbs it).
     ``scalars()`` returns the per-STEP means since the last call and
-    resets — emitted at the display cadence next to ``images_per_sec``.
+    resets the window — emitted at the display cadence next to
+    ``images_per_sec``. ``cumulative_work()`` survives window turns (it
+    clears only on a full ``reset()``, the compile boundary): host-side
+    work seconds (host_wait + dispatch — the time this host spent
+    producing the step rather than waiting in a collective) plus steps,
+    which is the straggler-attribution numerator the multi-host
+    coordinator ships in its vote.
     """
 
     KEYS = ("host_wait", "dispatch", "device")
@@ -270,18 +276,32 @@ class StepTimer:
     def reset(self) -> None:
         self._acc = dict.fromkeys(self.KEYS, 0.0)
         self._steps = 0
+        self._cum = dict.fromkeys(self.KEYS, 0.0)
+        self._cum_steps = 0
 
     def add(self, key: str, dt: float) -> None:
         self._acc[key] += dt
+        self._cum[key] += dt
 
     def steps(self, n: int = 1) -> None:
         self._steps += n
+        self._cum_steps += n
+
+    def cumulative_work(self) -> tuple[float, int]:
+        """(host-side work seconds, steps) since the last full reset.
+        Work = host_wait + dispatch: a straggler burns its step time
+        HERE, while its peers burn the same wall time blocked in the
+        device column (the collective wait) — so this is the column
+        that attributes the slowness to a host."""
+        return self._cum["host_wait"] + self._cum["dispatch"], \
+            self._cum_steps
 
     def scalars(self) -> dict:
         n = max(self._steps, 1)
         out = {f"step_{k}_s": round(self._acc[k] / n, 9)
                for k in self.KEYS}
-        self.reset()
+        self._acc = dict.fromkeys(self.KEYS, 0.0)
+        self._steps = 0
         return out
 
 

@@ -100,6 +100,24 @@ def run_train_device(process_id: int, num_processes: int, port: str, outdir: str
                    ("--device_data", "--device_chunk=4"))
 
 
+def run_train_straggler(process_id: int, num_processes: int, port: str,
+                        outdir: str) -> None:
+    """Straggler chaos (r12): a --fault_spec prefetch delay armed on
+    process 1 ONLY makes every one of its host batches ~40 ms late —
+    the slow-host signature. The vote's work_us column must then name
+    process 1 in the chief's step_skew_s/straggler_host scalars, and
+    both hosts' span files (+ coord_clock markers) must let
+    tools/fleet_report.py attribute the same straggler offline."""
+    extra = ["--coord_steps=4", "--model=mlp", "--keep_prob=1.0"]
+    if process_id == 1:
+        # 150 ms per staged batch: far above an MLP step, so the
+        # prefetch queue can never hide it and host_wait balloons
+        extra.append(
+            "--fault_spec=prefetch:mode=delay:delay=0.15:times=0")
+    run_train_loop(process_id, num_processes, port, outdir,
+                   tuple(extra), training_iter=24)
+
+
 def run_train_tp(process_id: int, num_processes: int, port: str, outdir: str) -> None:
     """--model_axis=2 across processes: TP+DP over the global mesh, state
     placed per-host via make_array_from_callback (shard_state_tp)."""
@@ -358,6 +376,7 @@ def run(process_id: int, num_processes: int, port: str, outdir: str) -> None:
 if __name__ == "__main__":
     mode = sys.argv[1]
     fn = {"step": run, "train": run_train_loop,
+          "train_straggler": run_train_straggler,
           "train_device": run_train_device, "train_tp": run_train_tp,
           "train_tp_span": run_train_tp_span,
           "train_sp": run_train_sp,

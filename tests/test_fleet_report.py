@@ -1,0 +1,142 @@
+"""Cross-host trace aggregation (tools/fleet_report.py) and the
+multi-file trace_view: clock alignment from coord_clock markers,
+per-step skew + straggler attribution, and the one-track-per-host
+Chrome export."""
+
+import json
+import os
+
+import pytest
+
+from tools import fleet_report, trace_view
+
+STEPS = 10
+BOUNDARIES = (0, 1, 2)
+OFFSET = 50.0  # worker-1's wall clock runs 50 s ahead
+
+
+def _write_fleet(tmp_path, straggler_dur=0.3, base_dur=0.1):
+    """Two hosts' span files: same steps, worker-1's clock shifted by
+    OFFSET and its per-step work 3x slower. coord_clock markers land at
+    matching boundaries (shifted by the same clock offset — the marker
+    pair is what encodes the offset)."""
+    t0 = 1000.0
+    for host, shift, dur in (("worker-0", 0.0, base_dur),
+                             ("worker-1", OFFSET, straggler_dur)):
+        recs = []
+        for i in range(STEPS):
+            recs.append({"name": "train_step", "step": i,
+                         "ts": t0 + shift + i * 1.0, "dur_s": dur,
+                         "thread": "MainThread", "depth": 0})
+        for b in BOUNDARIES:
+            recs.append({"name": "coord_clock", "boundary": b,
+                         "step": b * 4, "ts": t0 + shift + b * 4.0,
+                         "dur_s": 0.0, "instant": True})
+        with open(tmp_path / f"spans-{host}.jsonl", "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+    return [str(tmp_path / f"spans-worker-{i}.jsonl") for i in (0, 1)]
+
+
+def test_clock_offsets_from_coord_clock(tmp_path):
+    paths = _write_fleet(tmp_path)
+    by_host = {f"worker-{i}": trace_view.load_records(p)
+               for i, p in enumerate(paths)}
+    offsets = fleet_report.clock_offsets(by_host)
+    assert offsets["worker-0"] == 0.0  # the chief-looking reference
+    assert offsets["worker-1"] == pytest.approx(OFFSET)
+    merged = fleet_report.align(by_host, offsets)
+    # aligned: both hosts' step-i spans land at the same instant
+    step0 = [r["ts"] for r in merged
+             if r.get("name") == "train_step" and r["step"] == 0]
+    assert step0[0] == pytest.approx(step0[1])
+
+
+def test_straggler_attribution_and_skew(tmp_path):
+    paths = _write_fleet(tmp_path)
+    report = fleet_report.analyze(paths)
+    assert report["n_hosts"] == 2
+    assert report["attribution"] == "step_spans"  # no work_us markers
+    assert report["steps_compared"] == STEPS
+    assert report["straggler_host"] == "worker-1"
+    assert report["straggler_share"] == 1.0
+    assert report["skew_p50_s"] == pytest.approx(0.2)
+    assert report["skew_max_s"] == pytest.approx(0.2)
+    assert report["hosts"]["worker-1"]["straggler_steps"] == STEPS
+    assert report["hosts"]["worker-1"]["clock_offset_s"] == pytest.approx(
+        OFFSET)
+    # single host: attribution explicitly n/a, never a false positive
+    solo = fleet_report.analyze(paths[:1])
+    assert solo["straggler_host"] is None
+    assert solo["steps_compared"] == 0
+
+
+def test_vote_work_attribution_preferred(tmp_path):
+    """coord_clock markers carrying work_us (the live vote's numerator)
+    override span-duration attribution — a host whose slowness hides in
+    host_wait (no span) is still named."""
+    t0 = 1000.0
+    for host, work in (("worker-0", 900), ("worker-1", 45000)):
+        with open(tmp_path / f"spans-{host}.jsonl", "w") as f:
+            for i in range(STEPS):  # dispatch spans: EQUAL durations
+                f.write(json.dumps(
+                    {"name": "train_step", "step": i, "ts": t0 + i,
+                     "dur_s": 0.001}) + "\n")
+            for b in BOUNDARIES:
+                f.write(json.dumps(
+                    {"name": "coord_clock", "boundary": b, "step": b * 4,
+                     "ts": t0 + b * 4.0, "work_us": work,
+                     "instant": True}) + "\n")
+    report = fleet_report.analyze(
+        [str(tmp_path / f"spans-worker-{i}.jsonl") for i in (0, 1)])
+    assert report["attribution"] == "vote_work"
+    assert report["straggler_host"] == "worker-1"
+    assert report["steps_compared"] == len(BOUNDARIES)
+    assert report["skew_max_s"] == pytest.approx((45000 - 900) / 1e6)
+    assert report["per_boundary"][0]["work_us"] == {
+        "worker-0": 900, "worker-1": 45000}
+
+
+def test_fleet_report_cli_text_json_and_chrome(tmp_path, capsys):
+    _write_fleet(tmp_path)
+    assert fleet_report.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "straggler: worker-1" in out
+    assert "worker-0" in out and "clock_off" in out
+
+    chrome = str(tmp_path / "fleet.json")
+    assert fleet_report.main([str(tmp_path), "--chrome", chrome,
+                              "--json"]) == 0
+    out = capsys.readouterr().out
+    rep = json.loads(out.splitlines()[-1])
+    assert rep["straggler_host"] == "worker-1"
+    ct = json.load(open(chrome))
+    meta = [e for e in ct["traceEvents"] if e.get("ph") == "M"]
+    assert {m["args"]["name"] for m in meta} == {"worker-0", "worker-1"}
+    pids = {e["pid"] for e in ct["traceEvents"]}
+    assert len(pids) == 2  # one track per host
+    # empty target: loud nonzero exit
+    assert fleet_report.main([str(tmp_path / "nothing-here")]) == 2
+
+
+def test_trace_view_multi_file_host_tags(tmp_path, capsys):
+    paths = _write_fleet(tmp_path)
+    assert trace_view.main(paths) == 0
+    out = capsys.readouterr().out
+    assert "<worker-0>" in out and "<worker-1>" in out
+
+    # single file: no host column (the pre-r12 rendering)
+    assert trace_view.main(paths[:1]) == 0
+    out = capsys.readouterr().out
+    assert "<worker-0>" not in out and "train_step" in out
+
+    chrome = str(tmp_path / "view.json")
+    assert trace_view.main([*paths, "--chrome", chrome]) == 0
+    ct = json.load(open(chrome))
+    assert len({e["pid"] for e in ct["traceEvents"]}) == 2
+
+
+def test_host_from_path_convention():
+    assert trace_view.host_from_path("/x/spans-worker-3.jsonl") == "worker-3"
+    assert trace_view.host_from_path("/x/flightrec-serve-1.jsonl") == "serve-1"
+    assert trace_view.host_from_path("/x/custom.jsonl") == "custom"

@@ -180,6 +180,48 @@ def test_sp_train_loop_multihost(tmp_path):
     assert found is not None and found[1] == 12
 
 
+@pytest.mark.slow  # chaos: a full 2-process run with an armed delay fault
+def test_straggler_attribution_multihost(tmp_path):
+    """r12 fleet-efficiency chaos: a --fault_spec prefetch delay armed
+    on process 1 only. The cadenced vote's work_us column must name
+    host 1 in the chief's live step_skew_s/straggler_host scalars, and
+    tools/fleet_report.py over both hosts' span files must attribute
+    the same straggler offline (vote_work attribution via the
+    coord_clock markers)."""
+    import json as _json
+
+    outs = _spawn_workers("train_straggler", str(tmp_path))
+    for out in outs:
+        assert "TRAIN_OK" in out, out[-2000:]
+
+    metrics = [
+        _json.loads(l)
+        for l in open(os.path.join(str(tmp_path), "logs",
+                                   "metrics.jsonl"))
+    ]
+    skews = [m for m in metrics if "step_skew_s" in m]
+    assert skews, "no live skew scalars in the chief's metrics.jsonl"
+    # significance-aware: before the fault's first fire (and on the
+    # final partial window) skews are µs-level ties whose attribution
+    # is noise; every vote that saw REAL skew must name host 1
+    big = [m for m in skews if m["step_skew_s"] > 0.02]
+    assert big, f"no vote saw the injected 150 ms delay: {skews}"
+    assert all(int(m["straggler_host"]) == 1 for m in big), skews
+
+    # offline: the merged fleet report names the same host
+    import sys as _sys
+
+    if REPO not in _sys.path:
+        _sys.path.insert(0, REPO)
+    from tools import fleet_report
+
+    report = fleet_report.analyze(fleet_report.discover_span_files(
+        os.path.join(str(tmp_path), "logs")))
+    assert report["n_hosts"] == 2, report
+    assert report["attribution"] == "vote_work", report
+    assert report["straggler_host"] == "worker-1", report
+
+
 def test_kill_one_host_mid_run(tmp_path):
     """SIGTERM the non-chief mid-run: with the cadenced vote (no
     per-iteration allgather anymore) both processes must still exit at

@@ -154,6 +154,25 @@ def test_step_timer_window_means_and_reset():
     assert all(v == 0.0 for v in out2.values())
 
 
+def test_step_timer_cumulative_work_survives_windows():
+    """cumulative_work (the straggler-attribution numerator) counts
+    host_wait+dispatch across scalars() window turns, and clears only
+    on a full reset (the compile boundary)."""
+    st = StepTimer()
+    st.add("host_wait", 0.1)
+    st.add("dispatch", 0.2)
+    st.add("device", 5.0)  # collective wait: NOT work
+    st.steps(2)
+    st.scalars()  # window turn must not clear the cumulative ledger
+    st.add("dispatch", 0.3)
+    st.steps()
+    work, steps = st.cumulative_work()
+    assert work == pytest.approx(0.6)
+    assert steps == 3
+    st.reset()
+    assert st.cumulative_work() == (0.0, 0)
+
+
 # ------------------------------------------------------------ watchdog
 
 
@@ -332,6 +351,12 @@ def test_step_breakdown_scalars_in_every_loop_variant(
     for key in ("step_host_wait_s", "step_dispatch_s", "step_device_s"):
         assert key in rec and rec[key] >= 0
     assert "images_per_sec" in rec  # next to the throughput number
+    # r12 efficiency accounting rides the same emission in every variant
+    for key in ("mfu", "model_flops_per_sec", "goodput"):
+        assert key in rec, f"{variant}: no {key} scalar in {rec}"
+    assert 0.0 <= rec["mfu"] <= 1.0
+    assert 0.0 <= rec["goodput"] <= 1.0
+    assert rec["model_flops_per_sec"] >= 0
     span_files = glob.glob(f"{tmp_path}/logs/spans-*.jsonl")
     assert span_files, f"{variant}: no span sink"
     names = {json.loads(l)["name"]
@@ -404,6 +429,42 @@ def test_healthz_and_metrics_routes(tmp_path):
         bp = p["backpressure"]
         assert bp["queue_limit"] == 8 and bp["queue_depth"] == 0
         assert bp["saturated"] is False and bp["closed"] is False
+    finally:
+        srv.close()
+        pb.close(drain=False)
+
+
+def test_metrics_goodput_uptime_and_health_block(tmp_path):
+    """r12: /metrics carries the per-replica fields the router will
+    consume — goodput_uptime_pct plus a per-batcher health block (p99
+    trend between polls, saturation streak)."""
+    srv, pb = _serving_stack(tmp_path)
+    try:
+        pb.submit(np.ones(SEQ, np.float32)).result(10)
+        m1 = json.loads(urllib.request.urlopen(
+            srv.address + "/metrics", timeout=10).read())
+        assert m1["goodput_uptime_pct"] == pytest.approx(100.0)
+        h1 = m1["predict"]["health"]
+        assert h1["p99_ms"] >= 0
+        assert h1["p99_trend"] == "flat"  # no previous poll to compare
+        assert h1["saturation_streak"] == 0 and h1["closed"] is False
+
+        m2 = json.loads(urllib.request.urlopen(
+            srv.address + "/metrics", timeout=10).read())
+        h2 = m2["predict"]["health"]
+        assert h2["p99_prev_ms"] == pytest.approx(h1["p99_ms"])
+        assert h2["p99_trend"] in ("rising", "flat", "falling")
+
+        # close the batcher: uptime goodput starts decaying poll-over-
+        # poll (the downtime integrates lazily between polls)
+        pb.close(drain=False)
+        json.loads(urllib.request.urlopen(
+            srv.address + "/metrics", timeout=10).read())
+        time.sleep(0.2)
+        m3 = json.loads(urllib.request.urlopen(
+            srv.address + "/metrics", timeout=10).read())
+        assert m3["goodput_uptime_pct"] < 100.0
+        assert m3["predict"]["health"]["closed"] is True
     finally:
         srv.close()
         pb.close(drain=False)
@@ -529,6 +590,12 @@ def test_degraded_record_keeps_telemetry_facts_non_null():
     assert rec["telemetry_step_dispatch_s"] is not None
     assert rec["telemetry_breakdown_source"] == "synthetic"
     assert rec["telemetry_overhead_pct"] is None
+    # r12: the efficiency facts are host-only too — mfu/flops/goodput
+    # stay non-null in the outage record, MFU a real ratio in (0, 1]
+    assert rec.get("efficiency_error") is None, rec
+    assert rec["flops_per_step"] is not None
+    assert 0.0 < rec["mfu"] <= 1.0
+    assert 0.0 < rec["goodput"] <= 1.0
 
 
 def test_bench_telemetry_phase_fields():

@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""Merge a fleet's per-host telemetry into ONE clock-aligned timeline,
+with per-step skew histograms and straggler attribution.
+
+Every host writes its own ``spans-<host>.jsonl`` (PR-6 spine); this tool
+answers the question none of them can alone: WHICH HOST made the step
+slow. Three stages:
+
+1. **Clock alignment.** Each host drops a ``coord_clock`` instant marker
+   immediately after the multi-host coordinator's vote allgather
+   completes (training/loop._HostCoordinator) — a shared barrier all
+   hosts leave within network-jitter of each other. Matching markers by
+   boundary id gives per-host wall-clock offsets against the reference
+   host (median over all shared boundaries, robust to jittery
+   boundaries); every event's timestamp is shifted onto the reference
+   clock. Hosts with no shared markers align at offset 0 (single-host
+   files still merge).
+
+2. **Per-step / per-boundary skew.** Two attribution sources, same
+   semantics (work = time a host spent PRODUCING its step rather than
+   waiting in a collective — in synchronous training every host's wall
+   time per step is equal by construction, so work is the only column
+   that differs):
+
+   - each ``coord_clock`` marker carries its host's mean work-per-step
+     since the previous vote (``work_us`` — StepTimer.cumulative_work's
+     host_wait + dispatch, the exact numerator behind the live
+     ``step_skew_s``/``straggler_host`` scalars in metrics.jsonl).
+     This is the PRIMARY source: a slow input pipeline's lost time
+     hides in host_wait, which no per-step span covers.
+   - per step, the summed duration of a host's step-dispatch spans
+     (train_step / device_chunk / pp_step / pp_chunk / zero_step /
+     zero_chunk) — the fallback when no vote markers exist (span files
+     from single-host runs, hand-rolled harnesses).
+
+3. **Attribution.** Per-host straggler counts (boundary-based when vote
+   markers carry work, else span-based); the report's
+   ``straggler_host`` is the host that was slowest most often (None
+   when under 2 hosts). A skew histogram (p50/p90/max) says whether
+   that host is chronically slow or one bad step.
+
+Usage:
+    python tools/fleet_report.py LOGDIR                # all spans-*.jsonl
+    python tools/fleet_report.py spans-a.jsonl spans-b.jsonl
+    python tools/fleet_report.py LOGDIR --chrome fleet.json
+    python tools/fleet_report.py LOGDIR --json        # machine-readable
+
+stdlib-only beyond utils/telemetry (via tools/trace_view's loaders) —
+run it anywhere the JSONL files land, no jax, no chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from statistics import median as _median
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tools.trace_view import (  # noqa: E402
+    fleet_chrome_trace,
+    load_records,
+)
+
+# the per-step dispatch spans (one per training step or scan chunk);
+# their summed duration is a host's work time for the step
+STEP_SPANS = ("train_step", "device_chunk", "pp_step", "pp_chunk",
+              "zero_step", "zero_chunk")
+CLOCK_SPAN = "coord_clock"
+
+
+def discover_span_files(target: str) -> list[str]:
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target, "spans-*.jsonl")))
+    return [target] if os.path.exists(target) else []
+
+
+def clock_offsets(by_host: dict[str, list[dict]],
+                  reference: str | None = None) -> dict[str, float]:
+    """Per-host wall-clock offset (seconds to SUBTRACT from a host's
+    timestamps to land on the reference host's clock), from matching
+    ``coord_clock`` boundary markers. Hosts sharing no boundary with
+    the reference get 0.0."""
+    marks: dict[str, dict[int, float]] = {}
+    for host, recs in by_host.items():
+        marks[host] = {}
+        for r in recs:
+            if r.get("name") == CLOCK_SPAN and "boundary" in r:
+                # last marker per boundary wins (re-votes overwrite)
+                marks[host][int(r["boundary"])] = float(r.get("ts", 0.0))
+    hosts = sorted(by_host)
+    if reference is None:
+        # prefer worker-0/chief-looking names, else the first
+        reference = next((h for h in hosts if h.endswith("-0")), hosts[0])
+    ref_marks = marks.get(reference, {})
+    out = {}
+    for host in hosts:
+        if host == reference:
+            out[host] = 0.0
+            continue
+        shared = sorted(set(marks[host]) & set(ref_marks))
+        if not shared:
+            out[host] = 0.0
+            continue
+        out[host] = _median([marks[host][b] - ref_marks[b]
+                             for b in shared])
+    return out
+
+
+def align(by_host: dict[str, list[dict]],
+          offsets: dict[str, float]) -> list[dict]:
+    """One merged, clock-aligned, time-sorted record list."""
+    merged = []
+    for host, recs in by_host.items():
+        off = offsets.get(host, 0.0)
+        for r in recs:
+            r = dict(r)
+            r["ts"] = float(r.get("ts", 0.0)) - off
+            merged.append(r)
+    merged.sort(key=lambda r: r["ts"])
+    return merged
+
+
+def step_skews(by_host: dict[str, list[dict]]) -> list[dict]:
+    """[{step, skew_s, straggler, work: {host: s}}] for every step seen
+    on >= 2 hosts (per-host work = summed step-dispatch span durations
+    at that step; chunked loops tag the chunk's START step)."""
+    work: dict[int, dict[str, float]] = {}
+    for host, recs in by_host.items():
+        for r in recs:
+            if r.get("name") in STEP_SPANS and isinstance(
+                    r.get("step"), int):
+                w = work.setdefault(int(r["step"]), {})
+                w[host] = w.get(host, 0.0) + float(r.get("dur_s", 0.0))
+    out = []
+    for step in sorted(work):
+        w = work[step]
+        if len(w) < 2:
+            continue
+        hi = max(w, key=w.get)
+        out.append({"step": step,
+                    "skew_s": max(w.values()) - min(w.values()),
+                    "straggler": hi,
+                    "work": {h: round(s, 6) for h, s in w.items()}})
+    return out
+
+
+def boundary_skews(by_host: dict[str, list[dict]]) -> list[dict]:
+    """[{boundary, step, skew_s, straggler, work_us: {host: us}}] from
+    the coord_clock markers' work_us payload (the live vote's numerator
+    persisted into the span stream), for boundaries seen on >= 2 hosts
+    with nonzero work. Skew here is per-STEP work skew in seconds."""
+    marks: dict[int, dict[str, tuple[float, int]]] = {}
+    for host, recs in by_host.items():
+        for r in recs:
+            if r.get("name") == CLOCK_SPAN and "boundary" in r \
+                    and "work_us" in r:
+                b = int(r["boundary"])
+                marks.setdefault(b, {})[host] = (
+                    float(r["work_us"]), int(r.get("step", 0)))
+    out = []
+    for b in sorted(marks):
+        w = {h: us for h, (us, _step) in marks[b].items()}
+        if len(w) < 2 or max(w.values()) <= 0:
+            continue
+        hi = max(w, key=w.get)
+        out.append({"boundary": b,
+                    "step": max(s for _us, s in marks[b].values()),
+                    "skew_s": (max(w.values()) - min(w.values())) / 1e6,
+                    "straggler": hi,
+                    "work_us": {h: int(us) for h, us in w.items()}})
+    return out
+
+
+def load_by_host(paths: list[str]) -> dict[str, list[dict]]:
+    """Span files -> {host: records} (one parse; analyze and the chrome
+    export share the result)."""
+    by_host: dict[str, list[dict]] = {}
+    for p in paths:
+        recs = load_records(p)
+        if recs:
+            by_host.setdefault(recs[0].get("host", p), []).extend(recs)
+    return by_host
+
+
+def analyze(paths: list[str],
+            by_host: dict[str, list[dict]] | None = None) -> dict:
+    """The full fleet report as a dict (the CLI prints it; tests and
+    dashboards consume it directly). Attribution prefers the
+    boundary/work_us source (``attribution: "vote_work"``), falling
+    back to step-span durations (``"step_spans"``)."""
+    if by_host is None:
+        by_host = load_by_host(paths)
+    offsets = clock_offsets(by_host)
+    span_skews = step_skews(by_host)
+    vote_skews = boundary_skews(by_host)
+    chosen = vote_skews if vote_skews else span_skews
+    attribution = "vote_work" if vote_skews else "step_spans"
+    counts: dict[str, int] = {}
+    excess: dict[str, float] = {}  # skew-weighted: µs-level ties on
+    for s in chosen:               # healthy steps can't out-vote a real
+        counts[s["straggler"]] = counts.get(s["straggler"], 0) + 1
+        excess[s["straggler"]] = (excess.get(s["straggler"], 0.0)
+                                  + s["skew_s"])
+    skew_vals = sorted(s["skew_s"] for s in chosen)
+
+    def pct(q):
+        if not skew_vals:
+            return None
+        return skew_vals[min(len(skew_vals) - 1,
+                             int(q * (len(skew_vals) - 1)))]
+
+    hosts = {}
+    for host, recs in sorted(by_host.items()):
+        steps = [r["step"] for r in recs
+                 if r.get("name") in STEP_SPANS
+                 and isinstance(r.get("step"), int)]
+        hosts[host] = {
+            "spans": len(recs),
+            "steps": len(steps),
+            "step_range": [min(steps), max(steps)] if steps else None,
+            "work_s": round(sum(float(r.get("dur_s", 0.0)) for r in recs
+                                if r.get("name") in STEP_SPANS), 6),
+            "clock_offset_s": round(offsets.get(host, 0.0), 6),
+            "straggler_steps": counts.get(host, 0),
+        }
+    straggler = (max(excess, key=excess.get)
+                 if excess and len(by_host) > 1 else None)
+    return {
+        "hosts": hosts,
+        "n_hosts": len(by_host),
+        "attribution": attribution,
+        "steps_compared": len(chosen),
+        "skew_p50_s": pct(0.50),
+        "skew_p90_s": pct(0.90),
+        "skew_max_s": skew_vals[-1] if skew_vals else None,
+        "straggler_host": straggler,
+        "straggler_share": (round(counts[straggler] / len(chosen), 4)
+                            if straggler and chosen else None),
+        "per_step": span_skews,
+        "per_boundary": vote_skews,
+    }
+
+
+def print_report(report: dict, out=None) -> None:
+    out = out if out is not None else sys.stdout
+    print(f"fleet report — {report['n_hosts']} host(s), "
+          f"{report['steps_compared']} steps compared", file=out)
+    print(f"{'host':<16} {'spans':>7} {'steps':>6} {'work_s':>10} "
+          f"{'clock_off_s':>12} {'straggled':>9}", file=out)
+    for host, h in report["hosts"].items():
+        print(f"{host:<16} {h['spans']:>7} {h['steps']:>6} "
+              f"{h['work_s']:>10.3f} {h['clock_offset_s']:>12.6f} "
+              f"{h['straggler_steps']:>9}", file=out)
+    if report["steps_compared"]:
+        print(f"step skew: p50={report['skew_p50_s'] * 1e3:.3f}ms "
+              f"p90={report['skew_p90_s'] * 1e3:.3f}ms "
+              f"max={report['skew_max_s'] * 1e3:.3f}ms", file=out)
+    if report["straggler_host"] is not None:
+        print(f"straggler: {report['straggler_host']} (slowest on "
+              f"{report['straggler_share']:.0%} of compared steps; "
+              f"attribution: {report['attribution']})",
+              file=out)
+    else:
+        print("straggler: n/a (need step spans from >= 2 hosts)",
+              file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Clock-aligned fleet timeline + straggler "
+                    "attribution from per-host spans-*.jsonl")
+    ap.add_argument("targets", nargs="+",
+                    help="a logdir (all its spans-*.jsonl) or explicit "
+                         "span files")
+    ap.add_argument("--chrome", metavar="OUT.json", default=None,
+                    help="also write the clock-aligned Chrome trace, "
+                         "one track per host")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report as JSON instead of text")
+    args = ap.parse_args(argv)
+
+    paths = []
+    for t in args.targets:
+        paths.extend(discover_span_files(t))
+    if not paths:
+        print(f"no spans-*.jsonl under {args.targets}", file=sys.stderr)
+        return 2
+    by_host = load_by_host(paths)
+    report = analyze(paths, by_host=by_host)
+    if args.chrome:
+        merged = align(by_host, clock_offsets(by_host))
+        with open(args.chrome, "w") as f:
+            json.dump(fleet_chrome_trace(merged), f)
+        print(f"wrote clock-aligned fleet trace ({len(merged)} events, "
+              f"{len(by_host)} host tracks) to {args.chrome}")
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print_report(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,13 @@ chip required.
 --fault_spec grammar (utils/faults.py) — how the spec strings are
 discovered.
 
+``--flops MODEL [BATCH]`` prints the STATIC per-layer FLOPs budget for
+one training step of ``MODEL`` at ``BATCH`` (utils/efficiency.
+flops_budget — the same accounting behind every loop's ``mfu`` /
+``model_flops_per_sec`` scalars and bench.py's efficiency facts), plus
+the jitted-lowering ``cost_analysis()`` cross-check where the backend
+reports FLOPs. The --mem printer's sibling: memory there, compute here.
+
 ``--mem MODEL D [--zero Z] [--optimizer OPT]`` prints the STATIC
 per-chip memory budget for ``MODEL`` sharded ``--zero``-style over a
 D-way data axis (parallel/zero.zero_memory_budget — jax.eval_shape, no
@@ -30,6 +37,7 @@ Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
        python tools/trace_ops.py --schedule K M [V]
        python tools/trace_ops.py --faults
        python tools/trace_ops.py --mem MODEL D [--zero Z] [--optimizer OPT]
+       python tools/trace_ops.py --flops MODEL [BATCH]
 """
 
 from __future__ import annotations
@@ -179,6 +187,53 @@ def print_mem(model_name: str, d: int, zero_level: int | None = None,
           f"(zero3 re-gathers params in forward/backward instead)")
 
 
+def print_flops(model_name: str, batch: int = 128) -> None:
+    """Print the static per-layer FLOPs budget for one training step
+    (utils/efficiency.flops_budget — the exact accounting the loops'
+    ``mfu``/``model_flops_per_sec`` scalars use, so what prints here IS
+    what the metrics report), with the XLA ``cost_analysis()``
+    cross-check where the backend reports it. No chip required for the
+    analytic half."""
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from distributed_tensorflow_tpu.models import get_model
+    from distributed_tensorflow_tpu.utils.efficiency import (
+        TRAIN_FLOPS_MULTIPLIER,
+        flops_budget,
+    )
+
+    if model_name not in _MEM_MODELS:
+        raise SystemExit(f"--flops: unknown model {model_name!r}; "
+                         f"available: {sorted(_MEM_MODELS)}")
+    if batch < 1:
+        raise SystemExit(f"--flops: batch must be >= 1, got {batch}")
+    model = get_model(model_name, **_MEM_MODELS[model_name])
+    b = flops_budget(model, batch, xla=True)
+
+    print(f"static FLOPs budget — model={model_name} batch={batch} "
+          f"(analytic per-layer forward; training = "
+          f"{TRAIN_FLOPS_MULTIPLIER}x forward)")
+    total = b["fwd_flops_per_example"]
+    print(f"{'layer':<24} {'fwd FLOPs/example':>18} {'share':>7}")
+    for r in b["rows"]:
+        print(f"{r['layer']:<24} {r['flops']:>18,} "
+              f"{r['flops'] / total:>7.1%}")
+    print(f"{'TOTAL forward':<24} {total:>18,}")
+    print(f"\ntrain FLOPs/example (fwd+bwd): "
+          f"{b['train_flops_per_example']:,}")
+    print(f"train FLOPs/step at batch {batch}: {b['flops_per_step']:,}")
+    if b["xla_flops_per_step"] is not None:
+        ratio = b["xla_flops_per_step"] / b["flops_per_step"]
+        print(f"XLA cost_analysis cross-check: "
+              f"{int(b['xla_flops_per_step']):,} FLOPs/step "
+              f"({ratio:.2f}x analytic)")
+    else:
+        print("XLA cost_analysis cross-check: n/a (backend reports no "
+              "FLOPs or no backend)")
+
+
 def print_faults() -> None:
     """List the fault-injection registry (the --fault_spec grammar's
     source of truth — utils/faults.INJECTION_POINTS)."""
@@ -215,6 +270,9 @@ if __name__ == "__main__":
         print_schedule(k, m, v)
     elif sys.argv[1] == "--faults":
         print_faults()
+    elif sys.argv[1] == "--flops":
+        print_flops(sys.argv[2],
+                    int(sys.argv[3]) if len(sys.argv) > 3 else 128)
     elif sys.argv[1] == "--mem":
         rest = sys.argv[2:]
         zero_level = None
