@@ -975,8 +975,10 @@ def telemetry_ab_phase(ds, n_chips) -> dict:
     """Same-session A/B on the flagship device-resident chunk loop:
     telemetry ON (the loops' exact per-chunk instrumentation — span +
     watchdog-arm + StepTimer, PLUS the r12 accounting: EfficiencyMeter
-    scalars and an armed warn-mode Sentinel observation per chunk) vs
-    OFF (bare dispatch), same compiled executable.
+    scalars and an armed warn-mode Sentinel observation per chunk, PLUS
+    the r13 resource plane: a MemoryMeter display-cadence sample and a
+    CompileSentry signature note per chunk) vs OFF (bare dispatch),
+    same compiled executable.
     ``telemetry_overhead_pct`` is the acceptance number (< 2% required
     — now covering the full armed observability stack); the ON arm's
     StepTimer also yields the MEASURED step-time breakdown for the
@@ -993,7 +995,7 @@ def telemetry_ab_phase(ds, n_chips) -> dict:
             adam,
             create_train_state,
         )
-        from distributed_tensorflow_tpu.utils import telemetry
+        from distributed_tensorflow_tpu.utils import resources, telemetry
         from distributed_tensorflow_tpu.utils.efficiency import (
             EfficiencyMeter,
         )
@@ -1022,6 +1024,12 @@ def telemetry_ab_phase(ds, n_chips) -> dict:
                 telemetry.set_watchdog(
                     telemetry.Watchdog(3600.0) if arm == "on" else None)
                 snt = Sentinel(action="warn") if arm == "on" else None
+                # the r13 resource plane pays its display-site cost in
+                # the ON arm too: a memory sample (runtime stat query /
+                # live-array walk — no device sync) and a signature
+                # note per chunk
+                mm = resources.MemoryMeter() if arm == "on" else None
+                cs = resources.CompileSentry() if arm == "on" else None
                 state = create_train_state(model, opt, seed=0)
                 if mesh is not None:
                     state = replicate_state(mesh, state)
@@ -1047,6 +1055,8 @@ def telemetry_ab_phase(ds, n_chips) -> dict:
                         # doesn't pay and poison the A/B)
                         eff.scalars(batch_size * CHUNK)
                         snt.observe(c * CHUNK, {"loss": 1.0 + 1e-3 * c})
+                        mm.scalars()
+                        cs.observe("device_chunk", (CHUNK,))
                     else:
                         state, m = chunk_fn(state, data)
                     if sync_every and (c * CHUNK) % sync_every < CHUNK:
@@ -1172,6 +1182,119 @@ def efficiency_phase() -> dict:
         # later record's efficiency facts to null
         return {**_EFFICIENCY_NULLS,
                 "efficiency_error": f"{type(e).__name__}: {e}"[:200]}
+
+
+# r13: the resources phase — the resource plane's evidence
+# (utils/resources.py) on whatever backend is alive. The budget and
+# comm-ledger facts are ANALYTIC (jax.eval_shape, no chip); the live
+# HBM sample and the compile drill run on the default backend — chip in
+# a healthy record, CPU in the outage record (degraded_record runs this
+# AFTER _cpu_smoke has flipped the platform; the CPU fallback samples
+# live-array bytes) — so every field stays non-null in EVERY record.
+# The compile assertion is the bench contract's recompile pin: exactly
+# ONE compile per distinct chunk shape, ZERO on repeats.
+RESOURCES_BATCH = 128
+
+_RESOURCES_NULLS = {
+    "resources_hbm_live_bytes": None,
+    "resources_hbm_source": None,
+    "resources_hbm_analytic_state_bytes": None,
+    "resources_live_vs_analytic": None,
+    "resources_compiles_distinct_shapes": None,
+    "resources_recompiles": None,
+    "resources_compile_time_s": None,
+    "resources_comm_bytes_dp": None,
+    "resources_comm_bytes_zero1": None,
+}
+
+_RESOURCES_CACHE: dict = {}
+
+
+def resources_phase() -> dict:
+    """Resource-plane evidence on the flagship CNN: a live memory
+    sample cross-checked against the analytic per-chip budget
+    (``resource_budget`` — the live/analytic ratio is the artifact's
+    sanity number), the compile sentry driven end-to-end (==1 compile
+    per distinct chunk shape asserted, 0 on repeats — the no-churn
+    claim as a number), and the analytic DP/ZeRO comm-ledger bytes.
+
+    Cached per process (the efficiency_phase pattern): degraded
+    records and the test suite drive this repeatedly and must not
+    re-pay the jit compiles."""
+    if "out" in _RESOURCES_CACHE:
+        return dict(_RESOURCES_CACHE["out"])
+    try:
+        from distributed_tensorflow_tpu.models import DeepCNN
+        from distributed_tensorflow_tpu.training import (
+            adam,
+            create_train_state,
+        )
+        from distributed_tensorflow_tpu.utils import resources
+
+        model = DeepCNN()
+        opt = adam(1e-3)
+        budget = resources.resource_budget(model, opt, RESOURCES_BATCH)
+        led_dp = resources.comm_ledger(model, opt, RESOURCES_BATCH,
+                                       mode="dp", data_ways=8)
+        led_z1 = resources.comm_ledger(model, opt, RESOURCES_BATCH,
+                                       mode="zero1", data_ways=8,
+                                       zero_level=1)
+        # live sample with the state actually materialized
+        state = create_train_state(model, opt, seed=0)
+        jax.block_until_ready(state.params)
+        meter = resources.MemoryMeter(
+            analytic_bytes=budget["per_chip_state_bytes"])
+        s = meter.sample(tag="bench")
+        assert s is not None and s["in_use"] > 0, s
+        ratio = s["in_use"] / max(budget["per_chip_state_bytes"], 1)
+
+        # compile drill: the sentry must count exactly one compile per
+        # distinct chunk shape and none on repeats (signature ledger +
+        # the jax.monitoring backend-compile listener)
+        sentry = resources.CompileSentry()
+        prev_meter = resources.active_meter()
+        prev_sentry = resources.active_sentry()
+        resources.activate(meter=meter, sentry=sentry, budget=budget)
+        resources._install_compile_listener()
+        try:
+            fn = jax.jit(lambda a: (a * 2.0).sum())
+            for n in (4, 4, 8, 8, 4):
+                x = jnp.ones((n, 16), jnp.float32)
+                sentry.observe("bench_chunk", ((n, 16), "float32"))
+                jax.block_until_ready(fn(x))
+            warm = sentry.compiles_total
+            jax.block_until_ready(fn(jnp.ones((8, 16), jnp.float32)))
+            repeat_delta = sentry.compiles_total - warm
+        finally:
+            resources.activate(meter=prev_meter, sentry=prev_sentry,
+                               budget=None)
+        distinct = sentry.site_signatures("bench_chunk")
+        assert distinct == 2, (
+            f"{distinct} distinct chunk signatures, expected 2")
+        assert sentry.recompiles_total == 1, (
+            f"{sentry.recompiles_total} recompiles, expected exactly 1 "
+            f"(the second distinct shape) — repeats must not compile")
+        assert repeat_delta == 0, (
+            f"a repeated shape triggered {repeat_delta} backend "
+            f"compile(s) — the executable cache regressed")
+        _RESOURCES_CACHE["out"] = {
+            "resources_hbm_live_bytes": int(s["in_use"]),
+            "resources_hbm_source": s["source"],
+            "resources_hbm_analytic_state_bytes":
+                int(budget["per_chip_state_bytes"]),
+            "resources_live_vs_analytic": round(ratio, 4),
+            "resources_compiles_distinct_shapes": distinct,
+            "resources_recompiles": int(sentry.recompiles_total),
+            "resources_compile_time_s":
+                round(sentry.compile_time_s, 4),
+            "resources_comm_bytes_dp": led_dp["comm_bytes_per_step"],
+            "resources_comm_bytes_zero1": led_z1["comm_bytes_per_step"],
+        }
+        return dict(_RESOURCES_CACHE["out"])
+    except Exception as e:  # never kill the record over the drill
+        # failures are NOT cached (the efficiency_phase rule)
+        return {**_RESOURCES_NULLS,
+                "resources_error": f"{type(e).__name__}: {e}"[:200]}
 
 
 # r10: the dp_zero phase A/Bs replicated sync DP against --zero 1
@@ -1518,6 +1641,10 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
     # r12: MFU/goodput facts — analytic FLOPs budget x a measured CPU
     # step rate over the calibrated peak; non-null in the outage record
     out.update(efficiency_phase())
+    # r13: resource-plane facts — the budget/ledger halves are analytic
+    # and the live sample/compile drill run on the CPU fallback, so
+    # every resources_* field stays non-null in the outage record too
+    out.update(resources_phase())
     if partial:
         out.update(partial)
     return out
@@ -1629,6 +1756,9 @@ def _run_phases(out: dict):
     out.update(telemetry_ab_phase(ds, n_chips))
     # r12: MFU / model-FLOPs / goodput accounting on the live backend
     out.update(efficiency_phase())
+    # r13: the resource plane — live-vs-analytic HBM, the compile
+    # drill, and the analytic comm-ledger bytes
+    out.update(resources_phase())
 
     print(json.dumps(out))
 

@@ -176,6 +176,12 @@ def _write_flat(directory: str, flat: dict[str, np.ndarray], step: int,
     already-fetched flat array dict (no device interaction — safe to run
     on a background thread)."""
     with trace_span("ckpt_write", step=step):
+        # resource plane: one memory sample attributed to the save
+        # boundary (no-op without an active meter) — checkpoints are
+        # where host staging + serialization buffers spike
+        from distributed_tensorflow_tpu.utils import resources
+
+        resources.sample_note("ckpt_write")
         os.makedirs(directory, exist_ok=True)
         final = os.path.join(directory, f"{_PREFIX}-{step}.npz")
         _atomic_npz(directory, final,
@@ -716,6 +722,11 @@ def restore_with_fallback(directory: str, template, *,
     integrity verification still covers the WHOLE file (a corrupt
     optimizer slot means the set is damaged, params included)."""
     with trace_span("ckpt_restore", subtree=subtree or ""):
+        # resource plane: sample at the restore boundary — the run's
+        # first big allocation event (no-op without an active meter)
+        from distributed_tensorflow_tpu.utils import resources
+
+        resources.sample_note("ckpt_restore")
         return _restore_with_fallback_impl(directory, template,
                                            max_rescans=max_rescans,
                                            subtree=subtree)

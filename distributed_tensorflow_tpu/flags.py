@@ -495,11 +495,33 @@ def define_reference_flags():
                    "median/MAD baselines")
     DEFINE_float("sentinel_threshold", 10.0, "MADs above the rolling "
                  "median at which loss_spike/grad_explosion trip")
+    DEFINE_integer("hbm_sample_every", 1, "Live HBM accounting "
+                   "(utils/resources.MemoryMeter): sample "
+                   "device.memory_stats() every this many display "
+                   "boundaries and emit hbm_in_use_bytes/hbm_peak_bytes/"
+                   "hbm_headroom_pct scalars next to images_per_sec "
+                   "(backends without the stat fall back to live-array "
+                   "bytes, labeled; headroom is -1 without a reported "
+                   "limit). Samples ride the EXISTING display cadence — "
+                   "no new sync points — and land as hbm_sample spans "
+                   "for fleet_report/the OOM postmortem. 0 = off. "
+                   "Rides the telemetry spine (--telemetry=false "
+                   "disables it)")
+    DEFINE_integer("recompile_budget", 0, "Recompilation sentry "
+                   "(utils/resources.CompileSentry): if > 0, more than "
+                   "this many traced-signature recompiles inside a "
+                   "rolling 60 s window trips a storm report naming "
+                   "the churned shape/dtype delta (loud print, "
+                   "recompile_storm span, flight-recorder dump). "
+                   "0 = count only: the compiles_total/compile_time_s/"
+                   "recompiles_total scalars are always emitted while "
+                   "telemetry is on")
     FLAGS._register_validator(_validate_pipeline_flags)
     FLAGS._register_validator(_validate_zero_flags)
     FLAGS._register_validator(_validate_fault_spec)
     FLAGS._register_validator(_validate_telemetry_flags)
     FLAGS._register_validator(_validate_efficiency_flags)
+    FLAGS._register_validator(_validate_resource_flags)
     define_serving_flags()
 
 
@@ -552,6 +574,14 @@ def define_serving_flags():
     DEFINE_integer("serve_metrics_every", 50, "Emit serving scalars "
                    "(queue depth, p50/p99 latency, throughput, reload "
                    "counters) every this many microbatches (0 = off)")
+    DEFINE_float("serve_hbm_headroom_pct", 0.0, "Replica-drain floor: "
+                 "/healthz flips to 503 (ok=false, hbm_low_headroom) "
+                 "when the replica's live HBM headroom drops below "
+                 "this percent of the device limit — a router can "
+                 "drain a leaking replica before the allocator kills "
+                 "it mid-request. 0 = off. Only meaningful where the "
+                 "backend reports a memory limit (headroom reads -1 "
+                 "elsewhere and never trips the floor)")
     FLAGS._register_validator(_validate_serving_flags)
 
 
@@ -732,6 +762,58 @@ def _validate_efficiency_flags(values: dict):
         if float(values.get("sentinel_threshold") or 0.0) <= 0:
             raise ValueError("--sentinel_threshold must be > 0 (MADs "
                              "above the rolling median)")
+
+
+def _validate_resource_flags(values: dict):
+    """Parse-time validation of the resource-plane surface (the PR-2
+    _register_validator pattern): out-of-bounds values, or an ARMED
+    resource instrument under --telemetry=false (its samples, storm
+    spans, and OOM postmortems all ride the telemetry spine and would
+    be silently inert), surface at the command line with the bounds
+    named — not as dead observability mid-run."""
+    hse = values.get("hbm_sample_every")
+    if hse is not None and int(hse) < 0:
+        raise ValueError(f"--hbm_sample_every={hse} must be >= 0 "
+                         f"(0 = off; N = sample every Nth display "
+                         f"boundary)")
+    rb = values.get("recompile_budget")
+    if rb is not None and int(rb) < 0:
+        raise ValueError(f"--recompile_budget={rb} must be >= 0 "
+                         f"(0 = count recompiles but never trip)")
+    shp = values.get("serve_hbm_headroom_pct")
+    if shp is not None and not (0.0 <= float(shp) < 100.0):
+        raise ValueError(f"--serve_hbm_headroom_pct={shp} must be in "
+                         f"[0, 100) percent of the device limit "
+                         f"(0 = off; 100 would 503 a healthy replica)")
+    if shp is not None and float(shp) > 0 and hse is not None \
+            and int(hse) == 0:
+        raise ValueError(
+            "--serve_hbm_headroom_pct > 0 with --hbm_sample_every=0 is "
+            "silently inert (the drain floor reads the memory meter, "
+            "which 0 disables) — drop the floor or re-enable sampling")
+    telemetry_flag = values.get("telemetry")
+    if telemetry_flag is None or telemetry_flag:
+        return
+    # telemetry off: reject explicitly-armed resource instruments (the
+    # watchdog_s precedent — defaults pass, deviations in the armed
+    # direction are silently inert and must be named)
+    if rb is not None and int(rb) > 0:
+        raise ValueError(
+            "--recompile_budget > 0 with --telemetry=false is silently "
+            "inert (the recompile sentry's storm spans and flight-"
+            "recorder dumps ride the telemetry spine) — drop it or "
+            "re-enable --telemetry")
+    if shp is not None and float(shp) > 0:
+        raise ValueError(
+            "--serve_hbm_headroom_pct > 0 with --telemetry=false is "
+            "silently inert (the serving memory meter is part of the "
+            "telemetry spine and is never installed when telemetry is "
+            "off) — drop it or re-enable --telemetry")
+    if hse is not None and int(hse) > 1:
+        raise ValueError(
+            "--hbm_sample_every > 1 with --telemetry=false is silently "
+            "inert (HBM sampling rides the telemetry spine; "
+            "--telemetry=false already disables it) — drop one")
 
 
 def _validate_fault_spec(values: dict):

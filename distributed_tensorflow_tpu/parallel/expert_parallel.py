@@ -200,3 +200,22 @@ def make_ep_eval_step(model, mesh):
         return fn(params, batch)
 
     return eval_step
+
+
+def ep_comm_rows(act_bytes: int, n_moe_layers: int) -> list[dict]:
+    """Static per-step combine bytes for expert parallelism — the comm
+    ledger's EP rows. Every device routes identically and computes its
+    own experts' tokens; ONE psum per MoE layer combines the partial
+    outputs (~2|A| on the wire per the all-reduce convention), and the
+    backward psums the cotangent the same way."""
+    if n_moe_layers <= 0:
+        return []
+    per_pass = 2 * act_bytes * n_moe_layers
+    return [
+        {"collective": "psum(expert combine, forward)", "axis": "model",
+         "bytes": per_pass,
+         "note": f"{n_moe_layers} MoE layers x ~2|A| combine"},
+        {"collective": "psum(expert combine, backward)", "axis": "model",
+         "bytes": per_pass,
+         "note": "the combine's transpose redistributes cotangents"},
+    ]

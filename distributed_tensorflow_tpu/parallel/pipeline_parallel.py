@@ -487,3 +487,24 @@ def stage_batch_pp(mesh, batch):
          NamedSharding(mesh, P(DATA_AXIS, None))),
         batch,
     )
+
+
+def pp_comm_rows(act_bytes_per_microbatch: int, k_stages: int,
+                 microbatches: int, virtual_stages: int = 1) -> list[dict]:
+    """Static per-step boundary-transfer bytes for the stage ring — the
+    comm ledger's PP rows. Each microbatch's activation ppermutes
+    through ``K*V - 1`` boundary hops forward (the interleaved schedule
+    makes V shorter trips that add up to the same block sequence, plus
+    the V-1 wrap-around hops between groups), and the backward routes
+    the cotangent through the same hops in reverse. Tiny schedule
+    control traffic and the final metrics pmean are ignored."""
+    hops = max(0, k_stages * max(1, virtual_stages) - 1)
+    fwd = microbatches * hops * act_bytes_per_microbatch
+    return [
+        {"collective": "ppermute(activations, forward)", "axis": "model",
+         "bytes": fwd,
+         "note": f"{microbatches} microbatches x {hops} boundary hops"},
+        {"collective": "ppermute(cotangents, backward)", "axis": "model",
+         "bytes": fwd,
+         "note": "the transpose routes the same bytes in reverse"},
+    ]

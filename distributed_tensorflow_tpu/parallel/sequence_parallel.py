@@ -275,3 +275,25 @@ def make_sp_eval_step(model, mesh, per_token_targets: bool = False):
         return sharded(params, batch)
 
     return eval_step
+
+
+def sp_comm_rows(kv_block_bytes: int, ways: int,
+                 n_attn_layers: int) -> list[dict]:
+    """Static per-step ring-attention bytes — the comm ledger's SP rows.
+    Each attention layer rotates every device's k AND v token blocks
+    ``ways - 1`` hops around the ring forward; the backward replays the
+    ring (recompute) and additionally routes dk/dv back, so it moves
+    about twice the forward's bytes — an estimate by design (online-
+    softmax statistics are negligible next to the blocks)."""
+    if ways < 2 or n_attn_layers <= 0:
+        return []
+    fwd = n_attn_layers * (ways - 1) * 2 * kv_block_bytes
+    return [
+        {"collective": "ppermute(k/v ring, forward)", "axis": "model",
+         "bytes": fwd,
+         "note": f"{n_attn_layers} layers x {ways - 1} hops x (k+v) "
+                 f"blocks"},
+        {"collective": "ppermute(k/v ring + dk/dv, backward)",
+         "axis": "model", "bytes": 2 * fwd,
+         "note": "ring replay plus gradient blocks (~2x forward)"},
+    ]

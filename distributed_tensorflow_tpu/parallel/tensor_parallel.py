@@ -243,3 +243,25 @@ def stage_batch_tp(mesh: Mesh, batch):
     from distributed_tensorflow_tpu.parallel.data_parallel import shard_batch
 
     return shard_batch(mesh, batch)
+
+
+def tp_comm_rows(act_bytes: int, n_boundaries: int) -> list[dict]:
+    """Static per-step activation all-reduce bytes for the Megatron
+    split — the comm ledger's TP rows. Each row-split output boundary
+    psums one activation tensor forward (~2|A| on the wire, the
+    all-reduce convention) and its cotangent backward; XLA inserts the
+    collectives from the GSPMD layout, so this is the analytic twin of
+    what the partitioner schedules. ``n_boundaries`` is the count of
+    sync points per forward (transformer: attention-out + MLP-down per
+    block; the CNN FC stack: its one column->row boundary)."""
+    if n_boundaries <= 0:
+        return []
+    per_pass = 2 * act_bytes * n_boundaries
+    return [
+        {"collective": "all_reduce(activations, forward)", "axis": "model",
+         "bytes": per_pass,
+         "note": f"{n_boundaries} row-split boundaries x ~2|A|"},
+        {"collective": "all_reduce(cotangents, backward)", "axis": "model",
+         "bytes": per_pass,
+         "note": "the column-split inputs psum the backward pass"},
+    ]

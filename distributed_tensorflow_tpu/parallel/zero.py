@@ -457,3 +457,43 @@ def zero_memory_budget(model, optimizer, d: int) -> dict:
         "opt_reduction": (o_full / o_shard) if o_shard else 1.0,
         "param_reduction": (p_full / p_shard) if p_shard else 1.0,
     }
+
+
+def zero_comm_rows(grad_bytes: int, param_bytes: int, level: int,
+                   d: int) -> list[dict]:
+    """Static per-step collective wire bytes for this module's data-axis
+    patterns — the comm ledger's ZeRO/DP rows (utils/resources.
+    comm_ledger composes them; the formula lives next to the
+    collectives it prices). Conventions per the module docstring:
+    all-reduce ~2|G|, reduce-scatter |G|, all-gather |P|. ``level=0``
+    is plain replicated DP's grad all-reduce. A 1-way data axis moves
+    nothing."""
+    if d < 2:
+        return []
+    if level == 0:
+        return [{"collective": "all_reduce(grads)", "axis": "data",
+                 "bytes": 2 * grad_bytes,
+                 "note": "replicated DP: ring all-reduce moves ~2|G|"}]
+    _check_level(level)
+    rows = [{"collective": "psum_scatter(grads)", "axis": "data",
+             "bytes": grad_bytes,
+             "note": "reduce-scatter: each rank receives its 1/D chunk "
+                     "of the summed gradient (|G| on the wire)"}]
+    if level == 1:
+        rows.append({"collective": "all_gather(params)", "axis": "data",
+                     "bytes": param_bytes,
+                     "note": "one gather rebuilds the replicated "
+                             "updated params (|P|)"})
+    else:  # level 3: params live sharded, re-gathered fwd + bwd (remat)
+        rows[0]["collective"] = "reduce_scatter(grad transpose)"
+        rows[0]["note"] = ("the all_gather's transpose routes grad "
+                           "contributions to the owning rank (|G|)")
+        rows.append({"collective": "all_gather(params, forward)",
+                     "axis": "data", "bytes": param_bytes,
+                     "note": "sharded params materialize for the "
+                             "forward (|P|)"})
+        rows.append({"collective": "all_gather(params, backward remat)",
+                     "axis": "data", "bytes": param_bytes,
+                     "note": "jax.checkpoint re-gathers instead of "
+                             "keeping a full copy (|P|)"})
+    return rows

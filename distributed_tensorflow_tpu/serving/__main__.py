@@ -73,6 +73,16 @@ def build_serving_stack(FLAGS):
         mesh = make_mesh(MeshSpec(data=-1, model=int(FLAGS.serve_tp)))
     engine = InferenceEngine(model, FLAGS.logdir, mesh=mesh, tp=tp,
                              max_batch=FLAGS.serve_max_batch)
+    # resource plane (r13): the replica's memory meter + compile sentry
+    # (hbm_* scalars at the metrics cadence, the /metrics hbm block,
+    # the --serve_hbm_headroom_pct health floor). Stashed on the engine
+    # so the server and ServingMetrics share one monitor. No optimizer:
+    # the budget prices the params the replica actually holds.
+    from distributed_tensorflow_tpu.utils import resources
+
+    engine.resources = resources.monitor_from_flags(
+        FLAGS, model, None, FLAGS.serve_max_batch, len(jax.devices()),
+        model_axis=int(FLAGS.serve_tp) if tp else None)
     print(f"serving step {engine.step} from {FLAGS.logdir} "
           f"(restore fallback depth "
           f"{engine.restore_report.fallback_depth})")
@@ -134,8 +144,9 @@ def main(argv):
     engine, client, watcher, _metrics = build_serving_stack(FLAGS)
     if watcher is not None:
         watcher.start()
-    server = InferenceServer(engine, client, host=FLAGS.serve_host,
-                             port=FLAGS.serve_port)
+    server = InferenceServer(
+        engine, client, host=FLAGS.serve_host, port=FLAGS.serve_port,
+        hbm_headroom_floor_pct=FLAGS.serve_hbm_headroom_pct)
     print(f"serving on {server.address} "
           f"(POST /v1/predict, /v1/generate; GET /healthz, /stats)")
     try:

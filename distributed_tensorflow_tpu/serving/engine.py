@@ -38,6 +38,7 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     latest_checkpoint,
     restore_params_with_fallback,
 )
+from distributed_tensorflow_tpu.utils import resources
 from distributed_tensorflow_tpu.utils.faults import fault_point
 from distributed_tensorflow_tpu.utils.telemetry import trace_span
 
@@ -185,6 +186,12 @@ class InferenceEngine:
         x = np.asarray(x, dtype=self.input_dtype)
         b = x.shape[0]
         bucket = self._bucket(b)
+        # recompile sentry: the padded bucket shape is exactly what the
+        # jitted apply specializes on — a churning signature here is
+        # the storm the power-of-two bucketing exists to prevent
+        resources.note_signature(
+            "serve_predict",
+            ((bucket,) + tuple(x.shape[1:]), str(x.dtype)))
         if bucket > b:
             pad = np.zeros((bucket - b, *x.shape[1:]), x.dtype)
             xb = np.concatenate([x, pad], axis=0)
@@ -212,6 +219,9 @@ class InferenceEngine:
         prompts = np.asarray(prompts, dtype=np.int32)
         b = prompts.shape[0]
         bucket = max(self._bucket(b), 2)  # decode floor: see decode.py
+        resources.note_signature(
+            "serve_decode",
+            (bucket, int(prompts.shape[1]), int(max_new_tokens)))
         if bucket > b:
             pad = np.repeat(prompts[-1:], bucket - b, axis=0)
             prompts_b = np.concatenate([prompts, pad], axis=0)

@@ -213,6 +213,12 @@ class ServingMetrics:
         }
         if batcher.latency is not None:
             scalars.update(batcher.latency.summary(f"{p}latency_ms_"))
+        # resource plane (r13): the serving cadence emits the same
+        # hbm_*/compiles_* family the training loops do (the monitor is
+        # stashed on the engine by the serving entry point)
+        rm = getattr(self.engine, "resources", None)
+        if rm is not None:
+            scalars.update({f"{p}{k}": v for k, v in rm.scalars().items()})
         if self.logger is not None:
             self.logger.scalars(n, scalars)
             # the serving cadence is this logger's display step: push
@@ -284,9 +290,21 @@ class InferenceServer:
     """ThreadingHTTPServer wrapper owning the route -> batcher wiring."""
 
     def __init__(self, engine, client: InProcessClient,
-                 host: str = "127.0.0.1", port: int = 8000):
+                 host: str = "127.0.0.1", port: int = 8000,
+                 resources_monitor=None,
+                 hbm_headroom_floor_pct: float = 0.0):
         self.engine = engine
         self.client = client
+        # resource plane (r13): the replica's memory meter + compile
+        # sentry (utils/resources.ResourceMonitor, usually built by
+        # __main__ via monitor_from_flags and also stashed on the
+        # engine). --serve_hbm_headroom_pct: /healthz flips to 503
+        # below the floor so a router can drain a leaking replica
+        # BEFORE the allocator kills it mid-request.
+        self.resources = (resources_monitor
+                          if resources_monitor is not None
+                          else getattr(engine, "resources", None))
+        self.hbm_headroom_floor_pct = float(hbm_headroom_floor_pct or 0.0)
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.serving = self  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
@@ -314,19 +332,68 @@ class InferenceServer:
             if b is not None:
                 yield name, b
 
+    def _hbm_block(self) -> dict | None:
+        """The replica's live memory story for /metrics and /healthz:
+        aggregate in_use/peak/headroom plus per-device detail where the
+        backend reports it. Rate-limited sampling (``sample_if_stale``)
+        so a hot health-poll loop can't turn into a span flood. None
+        when no meter is armed (--telemetry=false or no monitor)."""
+        from distributed_tensorflow_tpu.utils import resources as _res
+
+        rm = self.resources
+        if rm is None or rm.meter is None:
+            return None
+        s = rm.meter.sample_if_stale(max_age_s=1.0, tag="serve_poll")
+        if s is None:
+            return None
+        per_device = [
+            {"device": d["device"],
+             "in_use_bytes": d["in_use"],
+             "peak_bytes": d["peak"],
+             "headroom_pct": _res.headroom_pct(d["in_use"],
+                                               d.get("limit", 0))}
+            for d in s.get("per_device", ())]
+        known = [d["headroom_pct"] for d in per_device
+                 if d["headroom_pct"] >= 0]
+        agg = _res.headroom_pct(s["in_use"], s.get("limit", 0))
+        return {
+            "in_use_bytes": int(s["in_use"]),
+            "peak_bytes": int(s["peak"]),
+            "limit_bytes": int(s.get("limit", 0)),
+            "headroom_pct": agg,
+            # the drain floor's number: ONE device near its limit must
+            # not hide behind idle peers in the aggregate ratio
+            "min_device_headroom_pct": min(known) if known else agg,
+            "source": s.get("source", "?"),
+            "per_device": per_device,
+        }
+
     def healthz(self) -> dict:
         """The per-replica health signal a router/load-balancer polls:
         liveness (every configured batcher still has a worker), the
-        served params version, and the current backpressure headline.
-        ``ok: false`` maps to HTTP 503 so an upstream health check can
-        act without parsing."""
+        served params version, the current backpressure headline, and —
+        with ``--serve_hbm_headroom_pct`` — the memory-drain floor
+        (headroom below it flips ok, so a leaking replica drains before
+        the allocator kills it). ``ok: false`` maps to HTTP 503 so an
+        upstream health check can act without parsing."""
         closed = [name for name, b in self._batchers() if b.closed]
         depth = sum(b.stats.as_dict()["queue_depth"]
                     for _, b in self._batchers())
-        return {"ok": not closed, "step": self.engine.step,
+        hbm = self._hbm_block()
+        # headroom -1 means "backend reports no limit" — unknown never
+        # trips the floor (a CPU-mesh replica is not 'leaking'). The
+        # floor judges the WORST device, not the aggregate: one chip
+        # near its limit must not hide behind idle peers.
+        low = bool(hbm is not None and self.hbm_headroom_floor_pct > 0
+                   and 0 <= hbm["min_device_headroom_pct"]
+                   < self.hbm_headroom_floor_pct)
+        return {"ok": not closed and not low, "step": self.engine.step,
                 "params_step": self.engine.step,
                 "closed_batchers": closed,
                 "queue_depth": depth,
+                "hbm_headroom_pct": (hbm["headroom_pct"]
+                                     if hbm is not None else None),
+                "hbm_low_headroom": low,
                 "uptime_s": round(time.monotonic() - self._t0, 3)}
 
     def _goodput_uptime_pct(self) -> float:
@@ -401,6 +468,15 @@ class InferenceServer:
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "goodput_uptime_pct": self._goodput_uptime_pct(),
         }
+        # resource plane (r13): the replica's memory block + compile
+        # counters — what the router reads next to the health trend
+        out["hbm"] = self._hbm_block()
+        snt = (self.resources.sentry if self.resources is not None
+               else None)
+        out["compiles_total"] = (float(snt.compiles_total)
+                                 if snt is not None else None)
+        out["recompiles_total"] = (float(snt.recompiles_total)
+                                   if snt is not None else None)
         for name, b in self._batchers():
             stats = b.stats.as_dict()
             entry = dict(stats)

@@ -55,7 +55,7 @@ from distributed_tensorflow_tpu.utils import (
     collective_sync_cadence,
     trace_span,
 )
-from distributed_tensorflow_tpu.utils import efficiency, telemetry
+from distributed_tensorflow_tpu.utils import efficiency, resources, telemetry
 
 
 @dataclass
@@ -160,13 +160,18 @@ class _charged:
         return False
 
 
-def _display_scalars(meter, stimer, eff) -> dict:
+def _display_scalars(meter, stimer, eff, rmon=None) -> dict:
     """The display-cadence scalar family every loop emits: throughput,
-    the step-time breakdown, and — when accounting is on — mfu /
-    model_flops_per_sec / goodput (utils/efficiency.py)."""
+    the step-time breakdown, — when accounting is on — mfu /
+    model_flops_per_sec / goodput (utils/efficiency.py), and — when the
+    resource plane is on — hbm_* / compiles_* / comm_bytes_per_step
+    (utils/resources.py; the HBM sample rides THIS cadence, no new
+    sync points)."""
     out = {"images_per_sec": meter.images_per_sec, **stimer.scalars()}
     if eff is not None:
         out.update(eff.scalars(meter.images_per_sec))
+    if rmon is not None:
+        out.update(rmon.scalars())
     return out
 
 
@@ -714,6 +719,8 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     stimer = StepTimer()
     eff = efficiency.meter_from_flags(FLAGS, model, FLAGS.batch_size,
                                       n_chips)
+    rmon = resources.monitor_from_flags(FLAGS, model, opt,
+                                        FLAGS.batch_size, n_chips)
     snt = _sentinel_for(FLAGS, sv, logger)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
@@ -765,13 +772,18 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                     logger.log_display(step, last_display["loss"],
                                        last_display["accuracy"])
                     logger.scalars(step,
-                                   _display_scalars(meter, stimer, eff))
+                                   _display_scalars(meter, stimer, eff,
+                                                    rmon))
                     logger.flush()
                     telemetry.get_tracer().flush()
                 if compile_done and not profile_done and not profiling:
                     jax.profiler.start_trace(FLAGS.profile_dir)
                     profiling = True
                     profile_stop_at = step + FLAGS.profile_steps
+                if rmon is not None:
+                    # the traced signature this dispatch specializes on
+                    # (recompile sentry; ~µs, outside the timed window)
+                    rmon.note_dispatch("train_step", batch)
                 t0 = time.perf_counter()
                 with trace_span("train_step", step=step), \
                         telemetry.armed("train_step", step=step):
@@ -1310,6 +1322,8 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
     meter = Throughput(FLAGS.batch_size, n_chips)
     eff = efficiency.meter_from_flags(FLAGS, model, FLAGS.batch_size,
                                       n_chips)
+    rmon = resources.monitor_from_flags(FLAGS, model, opt,
+                                        FLAGS.batch_size, n_chips)
     snt = _sentinel_for(FLAGS, sv, logger)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
@@ -1329,6 +1343,8 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
             batch = ds.train.next_batch(FLAGS.batch_size)
             staged = stage_batch_pp(mesh, batch)
             stimer.add("host_wait", time.perf_counter() - t0)
+            if rmon is not None:
+                rmon.note_dispatch("pp_step", staged)
             t0 = time.perf_counter()
             with trace_span("pp_step", step=step), \
                     telemetry.armed("pp_step", step=step):
@@ -1371,7 +1387,8 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
                     logger.log_display(step, last_display["loss"],
                                        last_display["accuracy"])
                     logger.scalars(step,
-                                   _display_scalars(meter, stimer, eff))
+                                   _display_scalars(meter, stimer, eff,
+                                                    rmon))
                     logger.flush()
                     telemetry.get_tracer().flush()
                 periodic_eval(host, step)
@@ -1456,6 +1473,8 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
     meter = Throughput(FLAGS.batch_size, n_chips)
     eff = efficiency.meter_from_flags(FLAGS, model, FLAGS.batch_size,
                                       n_chips)
+    rmon = resources.monitor_from_flags(FLAGS, model, opt,
+                                        FLAGS.batch_size, n_chips)
     snt = _sentinel_for(FLAGS, sv, logger)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
@@ -1478,6 +1497,10 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
             # arbitrary checkpointed step, then cap at the budget
             to_boundary = -step % FLAGS.display_step or chunk
             length = min(chunk, to_boundary, FLAGS.training_iter - step)
+            if rmon is not None:
+                # the chunk LENGTH is the signature the scan step
+                # specializes on (run_chunk caches one fn per length)
+                rmon.note_dispatch("pp_chunk", signature=(length,))
             t0 = time.perf_counter()
             with trace_span("pp_chunk", step=step, length=length), \
                     telemetry.armed("pp_chunk", step=step, length=length):
@@ -1533,7 +1556,8 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                     logger.log_display(step, last_display["loss"],
                                        last_display["accuracy"])
                     logger.scalars(step,
-                                   _display_scalars(meter, stimer, eff))
+                                   _display_scalars(meter, stimer, eff,
+                                                    rmon))
                     logger.flush()
                     telemetry.get_tracer().flush()
                 periodic_eval(host, step)
@@ -1647,6 +1671,8 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
     meter = Throughput(FLAGS.batch_size, n_chips)
     eff = efficiency.meter_from_flags(FLAGS, model, FLAGS.batch_size,
                                       n_chips)
+    rmon = resources.monitor_from_flags(FLAGS, model, opt,
+                                        FLAGS.batch_size, n_chips)
     snt = _sentinel_for(FLAGS, sv, logger)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
@@ -1696,13 +1722,16 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
                     logger.log_display(step, last_display["loss"],
                                        last_display["accuracy"])
                     logger.scalars(step,
-                                   _display_scalars(meter, stimer, eff))
+                                   _display_scalars(meter, stimer, eff,
+                                                    rmon))
                     logger.flush()
                     telemetry.get_tracer().flush()
                 if compile_done and not profile_done and not profiling:
                     jax.profiler.start_trace(FLAGS.profile_dir)
                     profiling = True
                     profile_stop_at = step + FLAGS.profile_steps
+                if rmon is not None:
+                    rmon.note_dispatch("zero_step", batch)
                 t0 = time.perf_counter()
                 with trace_span("zero_step", step=step), \
                         telemetry.armed("zero_step", step=step):
@@ -1826,6 +1855,8 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
     meter = Throughput(FLAGS.batch_size, n_chips)
     eff = efficiency.meter_from_flags(FLAGS, model, FLAGS.batch_size,
                                       n_chips)
+    rmon = resources.monitor_from_flags(FLAGS, model, opt,
+                                        FLAGS.batch_size, n_chips)
     snt = _sentinel_for(FLAGS, sv, logger)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
@@ -1869,7 +1900,7 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                 logger.log_display(step, last_display["loss"],
                                    last_display["accuracy"])
                 logger.scalars(step,
-                               _display_scalars(meter, stimer, eff))
+                               _display_scalars(meter, stimer, eff, rmon))
                 logger.flush()
                 telemetry.get_tracer().flush()
             if compile_done and not profile_done and not profiling:
@@ -1880,6 +1911,8 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
             # arbitrary checkpointed step, then cap at the budget
             to_boundary = -step % FLAGS.display_step or chunk
             length = min(chunk, to_boundary, FLAGS.training_iter - step)
+            if rmon is not None:
+                rmon.note_dispatch("zero_chunk", signature=(length,))
             t0 = time.perf_counter()
             with trace_span("zero_chunk", step=step, length=length), \
                     telemetry.armed("zero_chunk", step=step, length=length):
@@ -2043,6 +2076,8 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
     meter = Throughput(FLAGS.batch_size, n_chips)
     eff = efficiency.meter_from_flags(FLAGS, model, FLAGS.batch_size,
                                       n_chips)
+    rmon = resources.monitor_from_flags(FLAGS, model, opt,
+                                        FLAGS.batch_size, n_chips)
     snt = _sentinel_for(FLAGS, sv, logger)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
@@ -2090,7 +2125,8 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
                                 stall_s=_booked_stall(eff))
                 logger.log_display(step, last_display["loss"],
                                    last_display["accuracy"])
-                logger.scalars(step, _display_scalars(meter, stimer, eff))
+                logger.scalars(step,
+                               _display_scalars(meter, stimer, eff, rmon))
                 logger.flush()
                 telemetry.get_tracer().flush()
             if compile_done and not profile_done and not profiling:
@@ -2101,6 +2137,8 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
             # checkpointed step, then cap at the remaining step budget
             to_boundary = -step % FLAGS.display_step or chunk
             length = min(chunk, to_boundary, FLAGS.training_iter - step)
+            if rmon is not None:
+                rmon.note_dispatch("device_chunk", signature=(length,))
             t0 = time.perf_counter()
             with trace_span("device_chunk", step=step, length=length), \
                     telemetry.armed("device_chunk", step=step,

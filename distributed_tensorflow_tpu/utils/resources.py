@@ -1,0 +1,950 @@
+"""Resource plane: live HBM accounting with an OOM postmortem, a
+recompilation sentry, and the per-mode collective-comm ledger.
+
+PR 6/7 built the TIME plane — spans say where a step's milliseconds
+went, MFU/goodput say what they bought, sentinels say whether the run
+is dying. The RESOURCE plane was blind: ``device.memory_stats()`` was
+read only inside bench.py, nothing counted XLA compiles after the
+first, and only ``--zero`` carried analytic wire-bytes facts. The
+three ways the runtime's invisibility kills a production run are
+exactly these blind spots: silent HBM exhaustion, recompile storms,
+and unaccounted collective traffic. This module is the third and
+closing observability pillar — three coupled instruments over the one
+telemetry spine:
+
+- **HBM accounting** — ``MemoryMeter`` samples ``device.memory_stats()``
+  at the EXISTING display/sync cadences (no new sync points; the CPU
+  test mesh, which reports no stats, falls back to summing
+  ``jax.live_arrays()`` bytes — a real live number, labeled
+  ``source="live_arrays"``). Every loop variant and the serving stack
+  emit ``hbm_in_use_bytes`` / ``hbm_peak_bytes`` / ``hbm_headroom_pct``
+  next to ``images_per_sec``; each fresh sample also lands as an
+  ``hbm_sample`` instant span (so it rides the span sink, the flight
+  ring, and ``tools/fleet_report.py``'s per-host table). The live
+  numbers cross-check against a STATIC analytic budget
+  (``resource_budget`` — ``jax.eval_shape`` per-leaf params/opt plus an
+  activation estimate, generalized beyond ``zero_memory_budget`` to the
+  PP/TP/EP/SP layouts via each mode's own sharding rule).
+- **OOM postmortem** — a chained ``sys.excepthook`` recognizes
+  ``XlaRuntimeError`` / RESOURCE_EXHAUSTED and, before the normal
+  telemetry dump, records the analytic budget table and the top-N
+  largest live buffers (``jax.live_arrays()``) into the flight ring —
+  so an OOM is diagnosable from ``flightrec-*.jsonl`` alone: the last
+  memory samples (already riding the ring), what the budget SAID the
+  state should cost, and which buffers actually held the HBM.
+- **Recompilation sentry** — ``CompileSentry`` counts and times every
+  XLA compile (a ``jax.monitoring`` backend-compile listener — cache
+  hits don't fire) and keys dispatches by TRACED SIGNATURE
+  (``observe(site, signature)``): the first signature per site is the
+  expected first compile, every NEW signature after it is a recompile,
+  and the report names the exact shape/dtype delta (the dimension that
+  churned). ``--recompile_budget N`` arms a sentinel-ladder storm
+  warning: more than N recompiles inside a rolling window prints the
+  offending delta, drops a ``recompile_storm`` instant span, and dumps
+  the flight recorder — the shape-churn failure mode the serving
+  bucket system and schedules.py exist to prevent, now detectable when
+  it regresses.
+- **Comm ledger** — ``comm_ledger`` composes a static per-step analytic
+  of collective wire bytes from the parallel modules' OWN row builders
+  (``zero_comm_rows`` / ``pp_comm_rows`` / ``tp_comm_rows`` /
+  ``ep_comm_rows`` / ``sp_comm_rows`` — the formulas live next to the
+  collectives they price), surfaced as a ``comm_bytes_per_step`` scalar
+  in every loop, a ``comm_ledger`` instant span (fleet_report's
+  per-host column), and ``tools/trace_ops.py --comm``.
+
+stdlib-only at import time (jax and the model/optimizer layers import
+lazily inside the functions that need them) so the flags validator,
+``tools/mem_report.py``, and bench's host-only phases can import this
+from anywhere — the utils/telemetry contract.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import deque
+
+from distributed_tensorflow_tpu.utils import telemetry
+
+# error signatures that mean the device allocator gave up (the
+# jaxlib XlaRuntimeError for RESOURCE_EXHAUSTED, and the strings the
+# TPU/interpreter allocators put in the message)
+OOM_SIGNS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+             "Allocation failure")
+TOP_LIVE_BUFFERS = 8       # largest live buffers in the postmortem
+MEM_SAMPLE_RING = 64       # samples MemoryMeter retains for dumps
+RECOMPILE_WINDOW_S = 60.0  # rolling window behind --recompile_budget
+MAX_SIGS_PER_SITE = 256    # signature-ledger cap (FIFO eviction)
+
+F32_BYTES = 4
+
+
+# --------------------------------------------------------- HBM metering
+
+
+def _device_memory_sample() -> dict | None:
+    """One live memory reading across the local devices.
+
+    TPU/GPU backends report ``memory_stats()`` per device (bytes_in_use
+    / peak_bytes_in_use / bytes_limit — summed here, per-device detail
+    kept); the CPU test mesh reports None, so the fallback sums the
+    bytes of every live jax array in the process — a real (if
+    host-side) live-buffer number, labeled so nobody mistakes it for
+    HBM. None only when there is no backend at all."""
+    try:
+        import jax
+
+        per = []
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:  # noqa: BLE001 — absence of the stat
+                ms = None
+            if ms and "bytes_in_use" in ms:
+                per.append({
+                    "device": int(getattr(d, "id", len(per))),
+                    "in_use": int(ms["bytes_in_use"]),
+                    "peak": int(ms.get("peak_bytes_in_use",
+                                       ms["bytes_in_use"])),
+                    "limit": int(ms.get("bytes_limit", 0) or 0),
+                })
+        if per:
+            return {"in_use": sum(p["in_use"] for p in per),
+                    "peak": sum(p["peak"] for p in per),
+                    "limit": sum(p["limit"] for p in per),
+                    "source": "memory_stats", "per_device": per}
+        total = sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
+        return {"in_use": total, "peak": total, "limit": 0,
+                "source": "live_arrays", "per_device": []}
+    except Exception:  # noqa: BLE001 — accounting never kills a run
+        return None
+
+
+def headroom_pct(in_use: int, limit: int) -> float:
+    """Percent of the reported limit still free; -1.0 when the backend
+    reports no limit (the CPU fallback) — 'unknown', never 'plenty'."""
+    if limit and limit > 0:
+        return round(100.0 * max(0.0, 1.0 - in_use / limit), 4)
+    return -1.0
+
+
+class MemoryMeter:
+    """Live HBM accounting at the display cadence.
+
+    ``scalars()`` is the loops' call: it re-samples every
+    ``sample_every``-th call (``--hbm_sample_every`` display boundaries;
+    the sample is a runtime stat query / live-array walk — no device
+    sync) and returns the standard scalar family. Every FRESH sample
+    also lands as an ``hbm_sample`` instant span, which puts it in the
+    span sink (fleet_report's per-host hbm column), the flight ring
+    (the OOM postmortem's recent-samples section), and nowhere near the
+    hot path. ``peak`` is max(backend peak, own running max) so the CPU
+    fallback still has a peak story. ``sample_fn`` is the test seam."""
+
+    SCALARS = ("hbm_in_use_bytes", "hbm_peak_bytes", "hbm_headroom_pct")
+
+    def __init__(self, analytic_bytes: int | None = None,
+                 sample_every: int = 1, sample_fn=None):
+        self.analytic_bytes = (int(analytic_bytes)
+                               if analytic_bytes else None)
+        self.sample_every = max(1, int(sample_every))
+        self._sample_fn = sample_fn or _device_memory_sample
+        self._samples: deque = deque(maxlen=MEM_SAMPLE_RING)
+        self._lock = threading.Lock()
+        self._peak = 0
+        self._calls = 0
+        self._last: dict | None = None
+
+    def sample(self, tag: str = "") -> dict | None:
+        """Take one fresh reading now; returns it (or None with no
+        backend). Cheap: a per-device stats query, no sync."""
+        s = self._sample_fn()
+        if s is None:
+            return None
+        with self._lock:
+            self._peak = max(self._peak, int(s.get("peak") or s["in_use"]))
+            s = dict(s, peak=self._peak, t=time.time())
+            self._samples.append(s)
+            self._last = s
+        telemetry.get_tracer().record_instant(
+            "hbm_sample", in_use=int(s["in_use"]), peak=int(s["peak"]),
+            limit=int(s.get("limit", 0)), source=s.get("source", "?"),
+            **({"tag": tag} if tag else {}))
+        return s
+
+    def scalars(self) -> dict:
+        """The display-cadence scalar family (re-sampling every
+        ``sample_every``-th call). ``hbm_headroom_pct`` is -1.0 when the
+        backend reports no limit (documented sentinel, not 'plenty')."""
+        with self._lock:
+            calls, self._calls = self._calls, self._calls + 1
+            last = self._last
+        if last is None or calls % self.sample_every == 0:
+            last = self.sample() or last
+        if last is None:
+            return {}
+        out = {
+            "hbm_in_use_bytes": float(last["in_use"]),
+            "hbm_peak_bytes": float(last["peak"]),
+            "hbm_headroom_pct": headroom_pct(last["in_use"],
+                                             last.get("limit", 0)),
+        }
+        if self.analytic_bytes:
+            out["hbm_analytic_bytes"] = float(self.analytic_bytes)
+        return out
+
+    def sample_if_stale(self, max_age_s: float = 1.0,
+                        tag: str = "") -> dict | None:
+        """A fresh-enough reading without resampling on every call —
+        the serving health poll's entry point (a hot /healthz must not
+        turn into a sample-per-request span flood)."""
+        with self._lock:
+            last = self._last
+        if last is not None and time.time() - last["t"] < max_age_s:
+            return last
+        return self.sample(tag=tag) or last
+
+    def last_samples(self, k: int = MEM_SAMPLE_RING) -> list:
+        with self._lock:
+            return list(self._samples)[-k:]
+
+    @property
+    def last(self) -> dict | None:
+        with self._lock:
+            return self._last
+
+
+# ------------------------------------------------------ analytic budget
+
+
+def _abstract_state(model, optimizer):
+    """(abstract params, abstract opt_state|None) via jax.eval_shape —
+    no compute, no chip (the zero_memory_budget pattern)."""
+    import jax
+
+    if optimizer is not None:
+        from distributed_tensorflow_tpu.training.train_state import (
+            create_train_state,
+        )
+
+        st = jax.eval_shape(lambda: create_train_state(model, optimizer))
+        return st.params, st.opt_state
+    variables = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    if getattr(model, "stateful", False):
+        variables = variables["params"]
+    return variables, None
+
+
+def _param_divisor_fn(mode: str, data_ways: int, model_axis: int,
+                      zero_level: int, abstract_params):
+    """(path, leaf) -> divisor: each mode's own sharding rule, spec-
+    driven where a spec table exists (TP uses ``tp_param_specs``, EP the
+    expert-leaf rule) rather than re-deriving layouts here."""
+    import jax
+
+    if mode == "zero3":
+        return lambda path, leaf: data_ways
+    if mode == "pp":
+        # stage-sharded transformer blocks (num_blocks/K per device,
+        # whatever V — interleaving permutes, it doesn't change the
+        # per-device share); embed/head/norm replicate
+        def div(path, leaf):
+            keys = tuple(getattr(p, "key", getattr(p, "idx", None))
+                         for p in path)
+            return model_axis if "blocks" in keys else 1
+
+        return div
+    if mode == "tp":
+        from jax.sharding import PartitionSpec as P
+
+        from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
+        from distributed_tensorflow_tpu.parallel.tensor_parallel import (
+            tp_param_specs,
+        )
+
+        specs = tp_param_specs(abstract_params)
+        flat = {tuple(getattr(p, "key", getattr(p, "idx", None))
+                      for p in path): spec
+                for path, spec in jax.tree_util.tree_flatten_with_path(
+                    specs, is_leaf=lambda x: isinstance(x, P))[0]}
+
+        def div(path, leaf):
+            keys = tuple(getattr(p, "key", getattr(p, "idx", None))
+                         for p in path)
+            spec = flat.get(keys)
+            return (model_axis if spec is not None
+                    and any(ax == MODEL_AXIS for ax in spec) else 1)
+
+        return div
+    if mode == "ep":
+        from distributed_tensorflow_tpu.parallel.expert_parallel import (
+            _is_expert_leaf,
+        )
+
+        return lambda path, leaf: (model_axis if _is_expert_leaf(path)
+                                   else 1)
+    # dp / sp / local / zero1: params replicate
+    return lambda path, leaf: 1
+
+
+def _activation_rows(model, per_chip_batch: int,
+                     seq_scale: int = 1) -> list[dict]:
+    """Coarse per-chip activation estimate (f32 bytes of the layer
+    outputs a training step keeps live) — the budget's third column.
+    An ESTIMATE by design: remat/donation/XLA fusion all shrink the
+    real number; the point is the order of magnitude next to the exact
+    params/opt rows. ``seq_scale`` divides the token axis (SP)."""
+    b = max(1, int(per_chip_batch))
+    name = type(model).__name__
+    rows = []
+
+    def add(layer, elements):
+        rows.append({"layer": layer, "bytes": int(elements) * F32_BYTES})
+
+    if name == "DeepCNN":
+        s = model.image_size
+        s2 = -(-s // 2)
+        add("conv1+pool", b * s * s * 32 + b * s2 * s2 * 32)
+        add("conv2+pool", b * s2 * s2 * 64)
+        add("fc", b * model.hidden_units)
+        add("logits", b * model.num_classes)
+    elif name == "MLP":
+        add("hidden", b * model.hidden_units)
+        add("logits", b * model.num_classes)
+    elif name in ("ResNet", "ResNet20", "ResNet32"):
+        size = model.image_size
+        for si, width in enumerate(model.widths):
+            if si > 0:
+                size = -(-size // 2)
+            add(f"stage{si}", model.n * 2 * b * size * size * width)
+        add("head", b * model.num_classes)
+    elif name in ("MiniTransformer", "TransformerLM"):
+        s = max(1, model.seq_len // max(1, seq_scale))
+        d = model.d_model
+        # per block: x + qkv(3) + attn out + mlp hidden + mlp out
+        per_block = b * s * d * (6 + model.mlp_dim // d)
+        if not getattr(model, "attn_block", None) and seq_scale == 1:
+            # the dense score matrix, unless blockwise/ring streams it
+            per_block += b * model.num_heads * s * s
+        add(f"{model.num_blocks} blocks",
+            model.num_blocks * per_block)
+        if hasattr(model, "vocab_size"):
+            ce_block = getattr(model, "ce_block", None)
+            add("lm_head logits",
+                b * min(s, ce_block or s) * model.vocab_size)
+        else:
+            add("cls_head", b * model.num_classes)
+    else:
+        raise ValueError(
+            f"no activation rule for model type {name!r} — the resource "
+            f"budget knows deep_cnn/mlp/resnet*/transformer/lm")
+    return rows
+
+
+def resource_budget(model, optimizer=None, batch_size: int = 1, *,
+                    mode: str = "dp", data_ways: int = 1,
+                    model_axis: int = 1, zero_level: int = 0,
+                    virtual_stages: int = 1,
+                    microbatches: int = 0) -> dict:
+    """STATIC per-chip memory budget for ``model`` under one parallel
+    layout — ``zero_memory_budget`` generalized across the mode matrix
+    (``jax.eval_shape``, no chip, no compute): per-leaf param/opt bytes
+    with each mode's own sharding divisor (ZeRO chunks over data, PP
+    stages blocks, TP follows ``tp_param_specs``, EP the expert-leaf
+    rule), transient grad bytes (full leaves in every mode), and a
+    coarse activation estimate at the per-chip batch. The live
+    ``MemoryMeter`` numbers cross-check against ``per_chip_total``
+    (state + grads; activations listed separately — they are transient
+    and the cross-check happens between steps)."""
+    import math
+
+    import jax
+    import numpy as np
+
+    data_ways = max(1, int(data_ways))
+    model_axis = max(1, int(model_axis))
+    if mode.startswith("zero"):
+        zero_level = zero_level or int(mode[4:] or 0)
+    params, opt_state = _abstract_state(model, optimizer)
+    div_fn = _param_divisor_fn(mode, data_ways, model_axis, zero_level,
+                               params)
+    rows: list[dict] = []
+
+    from distributed_tensorflow_tpu.utils.pytree import path_key
+
+    def add_rows(kind, tree, divisor_fn, prefix: str = ""):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            n = math.prod(leaf.shape) if leaf.shape else 1
+            isz = np.dtype(leaf.dtype).itemsize
+            d = max(1, int(divisor_fn(path, leaf)))
+            rows.append({
+                "kind": kind,
+                "leaf": (prefix + path_key(path)).rstrip("/") or "(scalar)",
+                "bytes": n * isz,
+                # ceil over ELEMENTS (what the chips actually allocate —
+                # padding included, the zero_memory_budget convention)
+                "per_chip_bytes": (-(-n // d)) * isz,
+                "shard": d,
+            })
+
+    add_rows("param", params, div_fn)
+    if opt_state is not None:
+        pstruct = jax.tree.structure(params)
+        # opt slots that mirror the params shard like them; ZeRO-1/3
+        # additionally chunks every params-shaped slot over data
+        opt_div = div_fn
+        if mode in ("zero1", "zero3"):
+            opt_div = lambda path, leaf: data_ways
+
+        def walk_opt(entry, prefix: str):
+            if jax.tree.structure(entry) == pstruct:
+                add_rows("opt", entry, opt_div, prefix=prefix)
+            elif isinstance(entry, dict):
+                for k, v in entry.items():
+                    walk_opt(v, f"{prefix}{k}/")
+            else:
+                add_rows("opt", entry, lambda p, l: 1, prefix=prefix)
+
+        walk_opt(opt_state, "")
+
+    act_rows = _activation_rows(
+        model, -(-int(batch_size) // data_ways),
+        seq_scale=model_axis if mode == "sp" else 1)
+
+    def total(kind):
+        return sum(r["per_chip_bytes"] for r in rows if r["kind"] == kind)
+
+    p_chip, o_chip = total("param"), total("opt")
+    g_chip = sum(r["bytes"] for r in rows if r["kind"] == "param")
+    a_chip = sum(r["bytes"] for r in act_rows)
+    return {
+        "mode": mode, "data_ways": data_ways, "model_axis": model_axis,
+        "zero_level": zero_level, "batch_size": int(batch_size),
+        "rows": rows, "activation_rows": act_rows,
+        "per_chip": {"params": p_chip, "opt": o_chip, "grads": g_chip,
+                     "activations": a_chip},
+        # the live cross-check target: persistent state + the transient
+        # grad leaves every step materializes
+        "per_chip_total": p_chip + o_chip + g_chip,
+        "per_chip_state_bytes": p_chip + o_chip,
+        "param_bytes_full": g_chip,
+    }
+
+
+# ----------------------------------------------------------- comm ledger
+
+
+def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
+                mode: str = "dp", data_ways: int = 1, model_axis: int = 1,
+                zero_level: int = 0, virtual_stages: int = 1,
+                microbatches: int = 0) -> dict:
+    """STATIC per-step analytic of collective wire bytes for one
+    parallel layout, composed from the parallel modules' own row
+    builders (the formula lives next to the collective it prices).
+    Conventions match the existing docs: all-reduce moves ~2|G|,
+    reduce-scatter |G|, all-gather |P|; activation payloads are f32.
+    Returns {mode, rows: [{collective, axis, bytes, note}],
+    comm_bytes_per_step}."""
+    import math
+
+    import jax
+    import numpy as np
+
+    data_ways = max(1, int(data_ways))
+    model_axis = max(1, int(model_axis))
+    if mode.startswith("zero"):
+        zero_level = zero_level or int(mode[4:] or 0)
+    params, _ = _abstract_state(model, None)
+    param_bytes = sum(
+        (math.prod(l.shape) if l.shape else 1) * np.dtype(l.dtype).itemsize
+        for l in jax.tree.leaves(params))
+    grad_bytes = param_bytes
+    rows: list[dict] = []
+
+    from distributed_tensorflow_tpu.parallel.zero import zero_comm_rows
+
+    if mode in ("zero1", "zero3"):
+        rows += zero_comm_rows(grad_bytes, param_bytes, zero_level,
+                               data_ways)
+    elif data_ways > 1:
+        # every other multi-chip mode pays the plain DP grad all-reduce
+        # over its data rows
+        rows += zero_comm_rows(grad_bytes, param_bytes, 0, data_ways)
+
+    is_tf = type(model).__name__ in ("MiniTransformer", "TransformerLM")
+    seq = getattr(model, "seq_len", 0)
+    d_model = getattr(model, "d_model", 0)
+    if mode == "pp":
+        from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+            pp_comm_rows,
+        )
+
+        micro = int(microbatches) or model_axis
+        per_shard = -(-int(batch_size) // data_ways)
+        act = -(-per_shard // micro) * seq * d_model * F32_BYTES
+        rows += pp_comm_rows(act, model_axis, micro,
+                             virtual_stages=max(1, int(virtual_stages)))
+    elif mode == "tp" and model_axis > 1:
+        from distributed_tensorflow_tpu.parallel.tensor_parallel import (
+            tp_comm_rows,
+        )
+
+        per_shard = -(-int(batch_size) // data_ways)
+        if is_tf:
+            act = per_shard * seq * d_model * F32_BYTES
+            n_sync = 2 * model.num_blocks  # attention + MLP row-splits
+        else:
+            act = per_shard * getattr(model, "hidden_units", 1024) \
+                * F32_BYTES
+            n_sync = 1  # the FC stack's one column->row boundary
+        rows += tp_comm_rows(act, n_sync)
+    elif mode == "ep" and model_axis > 1:
+        from distributed_tensorflow_tpu.parallel.expert_parallel import (
+            ep_comm_rows,
+        )
+
+        per_shard = -(-int(batch_size) // data_ways)
+        act = per_shard * seq * d_model * F32_BYTES
+        rows += ep_comm_rows(act, getattr(model, "num_blocks", 1))
+    elif mode == "sp" and model_axis > 1:
+        from distributed_tensorflow_tpu.parallel.sequence_parallel import (
+            sp_comm_rows,
+        )
+
+        per_shard = -(-int(batch_size) // data_ways)
+        kv_block = per_shard * (seq // model_axis) * d_model * F32_BYTES
+        rows += sp_comm_rows(kv_block, model_axis,
+                             getattr(model, "num_blocks", 1))
+
+    return {
+        "mode": mode, "data_ways": data_ways, "model_axis": model_axis,
+        "rows": rows,
+        "comm_bytes_per_step": int(sum(r["bytes"] for r in rows)),
+    }
+
+
+# ---------------------------------------------------- recompile sentry
+
+
+def batch_signature(batch) -> tuple:
+    """The traced signature of a dispatch payload: (shape, dtype) per
+    leaf — exactly what jax.jit specializes executables on. Cheap
+    (a tree flatten of 1-3 leaves) so the loops can afford it per
+    dispatch."""
+    import jax
+
+    return tuple(
+        (tuple(getattr(a, "shape", ())),
+         str(getattr(a, "dtype", type(a).__name__)))
+        for a in jax.tree.leaves(batch))
+
+
+def _sig_delta(old, new) -> str:
+    """Human-readable description of what changed between two traced
+    signatures — the dimension/dtype the storm report names."""
+    if old is None:
+        return "first signature"
+    try:
+        if len(old) != len(new):
+            return f"arity {len(old)} -> {len(new)} leaves"
+        for i, (o, n) in enumerate(zip(old, new)):
+            if o == n:
+                continue
+            oshape, odt = o if isinstance(o, tuple) and len(o) == 2 \
+                else (o, "?")
+            nshape, ndt = n if isinstance(n, tuple) and len(n) == 2 \
+                else (n, "?")
+            if odt != ndt:
+                return f"leaf{i} dtype {odt} -> {ndt}"
+            if isinstance(oshape, tuple) and isinstance(nshape, tuple):
+                if len(oshape) != len(nshape):
+                    return (f"leaf{i} rank {len(oshape)} -> "
+                            f"{len(nshape)} ({oshape} -> {nshape})")
+                for dim, (a, b) in enumerate(zip(oshape, nshape)):
+                    if a != b:
+                        return (f"leaf{i} dim {dim}: {a} -> {b} "
+                                f"(shape {oshape} -> {nshape})")
+            return f"leaf{i} {o} -> {n}"
+        return "identical (?)"
+    except Exception:  # noqa: BLE001 — a weird signature must not crash
+        return f"{old!r} -> {new!r}"
+
+
+class CompileSentry:
+    """Counts and times every XLA compile, detects recompiles by traced
+    signature, and trips a storm warning past ``--recompile_budget``.
+
+    Two sources, one ledger: the ``jax.monitoring`` backend-compile
+    listener (installed once per process, forwarding to the ACTIVE
+    sentry) supplies ``compiles_total`` / ``compile_time_s`` — real
+    compiles only, cache hits don't fire. ``observe(site, signature)``
+    — called by the loops at each dispatch and by the serving engine
+    per bucket — supplies the recompile story: the first signature a
+    site ever shows is its expected first compile; a NEW signature
+    later is a recompile, and the delta (which dim/dtype churned) is
+    retained. More than ``budget`` recompiles inside ``window_s``
+    seconds prints a loud report naming the churning site and delta,
+    drops a ``recompile_storm`` instant span, and dumps the flight
+    recorder (the sentinel action-ladder's warn rung). ``budget=0``
+    counts but never trips."""
+
+    def __init__(self, budget: int = 0,
+                 window_s: float = RECOMPILE_WINDOW_S):
+        self.budget = max(0, int(budget))
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self.compiles_total = 0
+        self.compile_time_s = 0.0
+        self.recompiles_total = 0
+        self.storms = 0
+        self._sites: dict = {}       # site -> {sig: hits}
+        self._last_sig: dict = {}    # site -> most recent signature
+        self._recent: deque = deque()  # (t, site, delta) recompiles
+        self.last_delta: str | None = None
+
+    def on_compile_event(self, event: str, dur: float) -> None:
+        if not event.endswith("backend_compile_duration"):
+            return
+        with self._lock:
+            self.compiles_total += 1
+            self.compile_time_s += float(dur)
+
+    def site_signatures(self, site: str) -> int:
+        with self._lock:
+            return len(self._sites.get(site, ()))
+
+    def observe(self, site: str, signature) -> str | None:
+        """Record one dispatch; returns the delta string when this was
+        a recompile (a NEW signature on a known site), else None."""
+        storm = None
+        with self._lock:
+            sigs = self._sites.setdefault(site, {})
+            if signature in sigs:
+                sigs[signature] += 1
+                return None
+            prev = self._last_sig.get(site)
+            sigs[signature] = 1
+            self._last_sig[site] = signature
+            # bound the ledger: a client-controlled signature axis
+            # (e.g. serve_decode's per-request max_new_tokens) must not
+            # grow the MONITORING plane without limit in a long-lived
+            # replica — evict oldest-first (a re-seen evicted signature
+            # counts as a recompile again, which is the honest reading:
+            # its executable likely aged out of jit's cache too)
+            if len(sigs) > MAX_SIGS_PER_SITE:
+                sigs.pop(next(iter(sigs)))
+            if prev is None:
+                return None  # the site's expected first compile
+            self.recompiles_total += 1
+            delta = _sig_delta(prev, signature)
+            self.last_delta = f"{site}: {delta}"
+            now = time.monotonic()
+            self._recent.append((now, site, delta))
+            while self._recent and now - self._recent[0][0] > self.window_s:
+                self._recent.popleft()
+            if self.budget and len(self._recent) > self.budget:
+                storm = (site, delta, len(self._recent))
+                self._recent.clear()  # one report per storm incident
+                self.storms += 1
+        if storm is not None:
+            self._report_storm(*storm)
+        return delta
+
+    def _report_storm(self, site: str, delta: str, count: int) -> None:
+        line = "=" * 70
+        print(f"\n{line}\nRECOMPILE STORM: {count} recompiles inside "
+              f"{self.window_s:.0f}s (budget {self.budget}) — latest at "
+              f"site {site!r}: {delta}\n"
+              f"  every new traced signature costs a full XLA compile; "
+              f"a churning batch/bucket shape turns the step budget "
+              f"into compile time (pad to stable buckets — the serving "
+              f"power-of-two bucketing and schedules.py exist for "
+              f"this)\n{line}", flush=True)
+        telemetry.get_tracer().record_instant(
+            "recompile_storm", site=site, delta=delta, count=count,
+            budget=self.budget)
+        telemetry.flight_recorder().dump(f"recompile_storm:{site}")
+
+    def scalars(self) -> dict:
+        with self._lock:
+            return {
+                "compiles_total": float(self.compiles_total),
+                "compile_time_s": round(self.compile_time_s, 4),
+                "recompiles_total": float(self.recompiles_total),
+            }
+
+
+# one process-wide listener forwarding to the ACTIVE sentry (the
+# monitoring API has no unregister; the indirection makes re-runs and
+# tests safe — swap the sentry, not the listener)
+_ACTIVE: dict = {"meter": None, "sentry": None, "budget": None}
+_ACTIVE_LOCK = threading.Lock()
+_LISTENER = {"installed": False}
+
+
+def _install_compile_listener() -> None:
+    with _ACTIVE_LOCK:
+        if _LISTENER["installed"]:
+            return
+        _LISTENER["installed"] = True
+    try:
+        import jax
+
+        def _on_duration(event, duration, **kw):
+            s = _ACTIVE.get("sentry")
+            if s is not None:
+                s.on_compile_event(event, duration)
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception as e:  # noqa: BLE001 — no jax, no compile events
+        print(f"resources: compile listener unavailable: {e}")
+
+
+def activate(meter: MemoryMeter | None = None,
+             sentry: CompileSentry | None = None,
+             budget: dict | None = None) -> None:
+    """Install the instruments the process-wide hooks (compile
+    listener, OOM excepthook, checkpoint sample notes) forward to.
+    Passing None clears a slot."""
+    with _ACTIVE_LOCK:
+        _ACTIVE["meter"] = meter
+        _ACTIVE["sentry"] = sentry
+        _ACTIVE["budget"] = budget
+
+
+def active_meter() -> MemoryMeter | None:
+    return _ACTIVE.get("meter")
+
+
+def active_sentry() -> CompileSentry | None:
+    return _ACTIVE.get("sentry")
+
+
+def note_signature(site: str, signature) -> None:
+    """Module-level dispatch note for layers that don't hold a monitor
+    (the serving engine) — forwards to the active sentry, no-op
+    otherwise."""
+    s = _ACTIVE.get("sentry")
+    if s is not None:
+        s.observe(site, signature)
+
+
+def sample_note(tag: str) -> None:
+    """One memory sample attributed to a named boundary (checkpoint
+    save/restore — the big allocation events); no-op without an active
+    meter. Never raises."""
+    m = _ACTIVE.get("meter")
+    if m is None:
+        return
+    try:
+        m.sample(tag=tag)
+    except Exception:  # noqa: BLE001 — accounting never kills a run
+        pass
+
+
+# -------------------------------------------------------- OOM postmortem
+
+
+def _is_oom(exc_type, exc) -> bool:
+    name = getattr(exc_type, "__name__", "")
+    text = f"{name}: {exc}"
+    return "XlaRuntimeError" in name or any(s in text for s in OOM_SIGNS)
+
+
+def _top_live_buffers(n: int = TOP_LIVE_BUFFERS) -> list[dict]:
+    """The N largest live jax arrays (shape/dtype/bytes) — which
+    buffers actually hold the memory when the allocator gives up."""
+    try:
+        import jax
+
+        rows = [{"shape": list(getattr(a, "shape", ())),
+                 "dtype": str(getattr(a, "dtype", "?")),
+                 "nbytes": int(getattr(a, "nbytes", 0))}
+                for a in jax.live_arrays()]
+        rows.sort(key=lambda r: -r["nbytes"])
+        return rows[:n]
+    except Exception:  # noqa: BLE001 — the postmortem must still land
+        return []
+
+
+def oom_postmortem(exc=None, reason: str | None = None) -> str | None:
+    """Record the OOM story into the flight ring — the last memory
+    samples are already there (every ``hbm_sample`` instant rides it);
+    this adds the analytic budget table and the top-N largest live
+    buffers — then dump. Returns the flightrec path (None when no sink
+    is configured). Safe to call from any layer on any suspected-OOM
+    error; the chained excepthook calls it automatically."""
+    fr = telemetry.flight_recorder()
+    fr.record("note", {
+        "note": f"OOM postmortem: "
+                f"{type(exc).__name__ if exc is not None else 'manual'}: "
+                f"{str(exc)[:400]}"})
+    m = _ACTIVE.get("meter")
+    if m is not None:
+        try:
+            m.sample(tag="oom")  # one last reading, if the runtime answers
+        except Exception:  # noqa: BLE001
+            pass
+    budget = _ACTIVE.get("budget")
+    if budget:
+        top = sorted(budget.get("rows", ()),
+                     key=lambda r: -r["per_chip_bytes"])[:TOP_LIVE_BUFFERS]
+        fr.record("hbm_budget", {
+            "mode": budget.get("mode"),
+            "per_chip": budget.get("per_chip"),
+            "per_chip_total": budget.get("per_chip_total"),
+            "activation_bytes": sum(
+                r["bytes"] for r in budget.get("activation_rows", ())),
+            "largest_leaves": [
+                {"leaf": r["leaf"], "kind": r["kind"],
+                 "per_chip_bytes": r["per_chip_bytes"]} for r in top],
+        })
+    for row in _top_live_buffers():
+        fr.record("live_buffer", row)
+    return fr.dump(reason or (
+        f"oom:{type(exc).__name__}" if exc is not None else "oom:manual"))
+
+
+_OOM_HOOK = {"installed": False}
+
+
+def install_oom_hook() -> None:
+    """Chain an OOM recognizer onto ``sys.excepthook`` (in front of the
+    telemetry flight-recorder hook, which installed first): a crashing
+    ``XlaRuntimeError``/RESOURCE_EXHAUSTED enriches the ring with the
+    budget table and largest live buffers BEFORE the postmortem dump,
+    so the OOM is diagnosable from flightrec-*.jsonl alone. Idempotent."""
+    with _ACTIVE_LOCK:
+        if _OOM_HOOK["installed"]:
+            return
+        _OOM_HOOK["installed"] = True
+    prev = sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        try:
+            if _is_oom(exc_type, exc):
+                oom_postmortem(exc)
+        except Exception:  # noqa: BLE001 — never mask the real crash
+            pass
+        prev(exc_type, exc, tb)
+
+    sys.excepthook = _hook
+
+
+# ------------------------------------------------------ monitor + flags
+
+
+class ResourceMonitor:
+    """The loops' one-stop resource accountant: bundles the memory
+    meter, the compile sentry, and the comm ledger behind the two calls
+    the loops make — ``scalars()`` at the display cadence and
+    ``note_dispatch(site, batch|signature)`` per dispatch."""
+
+    def __init__(self, meter: MemoryMeter | None,
+                 sentry: CompileSentry | None,
+                 ledger: dict | None):
+        self.meter = meter
+        self.sentry = sentry
+        self.ledger = ledger
+
+    def scalars(self) -> dict:
+        out: dict = {}
+        if self.meter is not None:
+            out.update(self.meter.scalars())
+        if self.sentry is not None:
+            out.update(self.sentry.scalars())
+        if self.ledger is not None:
+            out["comm_bytes_per_step"] = float(
+                self.ledger["comm_bytes_per_step"])
+        return out
+
+    def note_dispatch(self, site: str, batch=None, signature=None) -> None:
+        if self.sentry is None:
+            return
+        sig = signature if signature is not None else batch_signature(batch)
+        self.sentry.observe(site, sig)
+
+
+def parallel_config_from_flags(FLAGS, n_chips: int) -> dict:
+    """Derive the budget/ledger layout config from the parsed flags —
+    the one flags->layout mapping the loops, bench, and tools share."""
+    model_axis = max(1, int(getattr(FLAGS, "model_axis", 1) or 1))
+    zero = int(getattr(FLAGS, "zero", 0) or 0)
+    if zero:
+        mode, model_axis = f"zero{zero}", 1
+    elif getattr(FLAGS, "pipeline", False):
+        mode = "pp"
+    elif getattr(FLAGS, "expert_parallel", False):
+        mode = "ep"
+    elif getattr(FLAGS, "seq_parallel", False):
+        mode = "sp"
+    elif model_axis > 1:
+        mode = "tp"
+    else:
+        mode = "dp"
+    return {
+        "mode": mode,
+        "data_ways": max(1, int(n_chips) // model_axis),
+        "model_axis": model_axis,
+        "zero_level": zero,
+        "virtual_stages": max(1, int(getattr(FLAGS, "virtual_stages", 1)
+                                     or 1)),
+        "microbatches": int(getattr(FLAGS, "pp_microbatches", 0) or 0),
+    }
+
+
+def monitor_from_flags(FLAGS, model, optimizer, batch_size: int,
+                       n_chips: int,
+                       model_axis: int | None = None) -> ResourceMonitor | None:
+    """The one flag->feature mapping for the resource plane
+    (``--hbm_sample_every`` / ``--recompile_budget``), shared by every
+    training loop and the serving entry point. None under
+    ``--telemetry=false`` (the plane rides the spine — its samples,
+    storm spans, and postmortems are all telemetry artifacts).
+    Installs the process-wide hooks (compile listener, OOM excepthook)
+    and emits the ``comm_ledger`` instant span the fleet report reads.
+
+    ``model_axis`` overrides the flag-derived layout with an explicit
+    TP degree — the serving entry point passes ``--serve_tp`` (a
+    TP-sharded replica's budget must price the 1/K params each chip
+    actually holds, not the training namespace's --model_axis)."""
+    if not bool(getattr(FLAGS, "telemetry", True)):
+        return None
+    cfg = parallel_config_from_flags(FLAGS, n_chips)
+    if model_axis is not None and int(model_axis) > 1:
+        cfg.update(mode="tp", model_axis=int(model_axis),
+                   data_ways=max(1, int(n_chips) // int(model_axis)),
+                   zero_level=0)
+    budget = ledger = None
+    try:
+        budget = resource_budget(model, optimizer, batch_size, **cfg)
+    except Exception as e:  # noqa: BLE001 — accounting never blocks a run
+        print(f"resource accounting: analytic budget unavailable: {e}")
+    if optimizer is not None:
+        # the ledger prices a TRAINING step's collectives; a serving
+        # caller (no optimizer) has no grad traffic to price
+        try:
+            ledger = comm_ledger(model, optimizer, batch_size, **cfg)
+        except Exception as e:  # noqa: BLE001
+            print(f"resource accounting: comm ledger unavailable: {e}")
+    sample_every = int(getattr(FLAGS, "hbm_sample_every", 1) or 0)
+    # the cross-check anchor is the PERSISTENT state (params+opt):
+    # samples land at display boundaries, between steps, where grads
+    # and activations are transient (and --device_data's resident
+    # split is a documented live-over-analytic delta)
+    meter = (MemoryMeter(
+        analytic_bytes=budget["per_chip_state_bytes"] if budget else None,
+        sample_every=sample_every) if sample_every > 0 else None)
+    sentry = CompileSentry(
+        budget=int(getattr(FLAGS, "recompile_budget", 0) or 0))
+    _install_compile_listener()
+    install_oom_hook()
+    activate(meter=meter, sentry=sentry, budget=budget)
+    if ledger is not None:
+        telemetry.get_tracer().record_instant(
+            "comm_ledger", mode=ledger["mode"],
+            comm_bytes_per_step=ledger["comm_bytes_per_step"],
+            data_ways=ledger["data_ways"],
+            model_axis=ledger["model_axis"])
+    return ResourceMonitor(meter, sentry, ledger)

@@ -220,6 +220,14 @@ def analyze(paths: list[str],
         steps = [r["step"] for r in recs
                  if r.get("name") in STEP_SPANS
                  and isinstance(r.get("step"), int)]
+        # resource plane (r13): each fresh MemoryMeter sample rides the
+        # span stream as an hbm_sample instant, and the loop drops one
+        # comm_ledger marker at startup — the per-host memory/wire
+        # columns come for free from the files already being merged
+        hbm_peaks = [int(r["peak"]) for r in recs
+                     if r.get("name") == "hbm_sample" and "peak" in r]
+        comm = next((r for r in recs if r.get("name") == "comm_ledger"
+                     and "comm_bytes_per_step" in r), None)
         hosts[host] = {
             "spans": len(recs),
             "steps": len(steps),
@@ -228,6 +236,9 @@ def analyze(paths: list[str],
                                 if r.get("name") in STEP_SPANS), 6),
             "clock_offset_s": round(offsets.get(host, 0.0), 6),
             "straggler_steps": counts.get(host, 0),
+            "hbm_peak_bytes": max(hbm_peaks) if hbm_peaks else None,
+            "comm_bytes_per_step": (int(comm["comm_bytes_per_step"])
+                                    if comm is not None else None),
         }
     straggler = (max(excess, key=excess.get)
                  if excess and len(by_host) > 1 else None)
@@ -249,14 +260,20 @@ def analyze(paths: list[str],
 
 def print_report(report: dict, out=None) -> None:
     out = out if out is not None else sys.stdout
+    def _mb(n):
+        return f"{n / 2 ** 20:.1f}M" if n is not None else "-"
+
     print(f"fleet report — {report['n_hosts']} host(s), "
           f"{report['steps_compared']} steps compared", file=out)
     print(f"{'host':<16} {'spans':>7} {'steps':>6} {'work_s':>10} "
-          f"{'clock_off_s':>12} {'straggled':>9}", file=out)
+          f"{'clock_off_s':>12} {'straggled':>9} {'hbm_peak':>9} "
+          f"{'comm/step':>10}", file=out)
     for host, h in report["hosts"].items():
         print(f"{host:<16} {h['spans']:>7} {h['steps']:>6} "
               f"{h['work_s']:>10.3f} {h['clock_offset_s']:>12.6f} "
-              f"{h['straggler_steps']:>9}", file=out)
+              f"{h['straggler_steps']:>9} "
+              f"{_mb(h.get('hbm_peak_bytes')):>9} "
+              f"{_mb(h.get('comm_bytes_per_step')):>10}", file=out)
     if report["steps_compared"]:
         print(f"step skew: p50={report['skew_p50_s'] * 1e3:.3f}ms "
               f"p90={report['skew_p90_s'] * 1e3:.3f}ms "

@@ -33,11 +33,20 @@ totals for replicated DP vs ZeRO-1 vs ZeRO-3, plus the per-step
 comm-volume comparison (all-reduce 2|G| vs reduce-scatter+all-gather
 |G|+|P|) — the D-fold saving auditable anywhere.
 
+``--comm MODEL D [--model_axis K] [--batch B]`` prints the STATIC
+per-step collective-comm ledger (utils/resources.comm_ledger — the
+parallel modules' own row builders) for every applicable mode at one
+glance: DP all-reduce, ZeRO-1/3 reduce-scatter+gather, PP boundary
+ppermutes, TP/EP activation psums, SP ring hops — wire bytes per step,
+per mode, no chip. The --mem/--flops printers' third sibling: memory,
+compute, and now the wire.
+
 Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
        python tools/trace_ops.py --schedule K M [V]
        python tools/trace_ops.py --faults
        python tools/trace_ops.py --mem MODEL D [--zero Z] [--optimizer OPT]
        python tools/trace_ops.py --flops MODEL [BATCH]
+       python tools/trace_ops.py --comm MODEL D [--model_axis K] [--batch B]
 """
 
 from __future__ import annotations
@@ -234,6 +243,53 @@ def print_flops(model_name: str, batch: int = 128) -> None:
               "FLOPs or no backend)")
 
 
+def print_comm(model_name: str, d: int, model_axis: int = 2,
+               batch: int = 128) -> None:
+    """Print the static per-step collective-comm ledger for every mode
+    that applies to ``MODEL`` on ``D`` chips — the same
+    ``utils/resources.comm_ledger`` accounting behind every loop's
+    ``comm_bytes_per_step`` scalar, so what prints here IS what the
+    metrics report. No chip (jax.eval_shape only)."""
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from distributed_tensorflow_tpu.models import get_model
+    from distributed_tensorflow_tpu.utils.resources import comm_ledger
+
+    if model_name not in _MEM_MODELS:
+        raise SystemExit(f"--comm: unknown model {model_name!r}; "
+                         f"available: {sorted(_MEM_MODELS)}")
+    if d < 1:
+        raise SystemExit(f"--comm: D={d} must be >= 1")
+    model_axis = max(2, model_axis)
+    model = get_model(model_name, **_MEM_MODELS[model_name])
+    is_tf = model_name in ("lm",)
+    modes = [("dp", dict(data_ways=d)),
+             ("zero1", dict(data_ways=d, zero_level=1)),
+             ("zero3", dict(data_ways=d, zero_level=3))]
+    if is_tf and d >= model_axis:
+        dw = max(1, d // model_axis)
+        modes += [("pp", dict(data_ways=dw, model_axis=model_axis)),
+                  ("tp", dict(data_ways=dw, model_axis=model_axis)),
+                  ("sp", dict(data_ways=dw, model_axis=model_axis))]
+    print(f"static per-step comm ledger — model={model_name} D={d} "
+          f"batch={batch}"
+          + (f" model_axis={model_axis}" if is_tf else "")
+          + " (analytic; all-reduce ~2|G|, reduce-scatter |G|, "
+            "all-gather |P|)")
+    for mode, cfg in modes:
+        led = comm_ledger(model, None, batch, mode=mode, **cfg)
+        print(f"\n{mode} (data x model = {led['data_ways']} x "
+              f"{led['model_axis']}): "
+              f"{_fmt_bytes(led['comm_bytes_per_step'])}/step")
+        for r in led["rows"]:
+            print(f"  {r['collective']:<40} {r['axis']:<6} "
+                  f"{_fmt_bytes(r['bytes']):>12}  {r.get('note', '')}")
+        if not led["rows"]:
+            print("  (no collectives — single-chip layout)")
+
+
 def print_faults() -> None:
     """List the fault-injection registry (the --fault_spec grammar's
     source of truth — utils/faults.INJECTION_POINTS)."""
@@ -273,6 +329,20 @@ if __name__ == "__main__":
     elif sys.argv[1] == "--flops":
         print_flops(sys.argv[2],
                     int(sys.argv[3]) if len(sys.argv) > 3 else 128)
+    elif sys.argv[1] == "--comm":
+        rest = sys.argv[2:]
+        model_axis = 2
+        batch = 128
+        if "--model_axis" in rest:
+            i = rest.index("--model_axis")
+            model_axis = int(rest[i + 1])
+            rest = rest[:i] + rest[i + 2:]
+        if "--batch" in rest:
+            i = rest.index("--batch")
+            batch = int(rest[i + 1])
+            rest = rest[:i] + rest[i + 2:]
+        print_comm(rest[0], int(rest[1]) if len(rest) > 1 else 8,
+                   model_axis, batch)
     elif sys.argv[1] == "--mem":
         rest = sys.argv[2:]
         zero_level = None
