@@ -556,10 +556,14 @@ def pp_device_phase(n_chips) -> dict:
     ds = LMDataSet(PP_EP_SPLIT, seq_len=PP_EP_SEQ_LEN,
                    vocab_size=PP_EP_VOCAB, seed=0)
     data = put_device_data(ds, mesh, data_sharded=True)
-    base = create_train_state(model, opt, seed=0)
     v_best = _pp_virtual_stages(ways)
     rates = {}
     for v in sorted({1, v_best}):
+        # fresh base per arm: device_put can ALIAS a committed host
+        # leaf into the placed state, and the step's donation then
+        # deletes it — re-stacking a shared base on the next arm would
+        # read deleted buffers (the CPU-backend aliasing path)
+        base = create_train_state(model, opt, seed=0)
         state = shard_state_pp(base, mesh, virtual_stages=v)
         fn = make_pp_device_train_step(model, opt, mesh, batch, ways,
                                        keep_prob=1.0, chunk=PP_EP_CHUNK,
@@ -1434,6 +1438,211 @@ def dp_zero_phase(ds, n_chips) -> dict:
     return out
 
 
+# r14: the overlap phase A/Bs the remaining on-device stalls' fixes in
+# one session — (a) the three pipeline schedules (gpipe / interleaved /
+# zero-bubble, --pp_schedule) on the 8-block model: zb splits backward
+# into B/W ticks and fills the cooldown with deferred weight grads, so
+# its analytic useful-tick fraction strictly exceeds interleaved at the
+# same (K, M, V); (b) ZeRO comm/compute overlap (--zero_overlap) on vs
+# off at levels 1 and 3 on the flagship CNN. The schedule fractions and
+# exposed-comm bytes are ANALYTIC (no chip) and recorded in EVERY
+# record including the degraded/outage one; the A/B rates need chips.
+OVERLAP_TIMED_CHUNKS = 3
+OVERLAP_BUCKET_MB = 4.0
+
+_OVERLAP_RATE_KEYS = (
+    "overlap_pp_gpipe_images_per_sec_per_chip",
+    "overlap_pp_interleaved_images_per_sec_per_chip",
+    "overlap_pp_zb_images_per_sec_per_chip",
+    "pp_zb_speedup_vs_interleaved",
+    "zero1_serial_images_per_sec_per_chip",
+    "zero1_overlap_images_per_sec_per_chip",
+    "zero3_serial_images_per_sec_per_chip",
+    "zero3_overlap_images_per_sec_per_chip",
+)
+
+
+def _pp_zb_virtual_stages(ways: int) -> int:
+    """Virtual-stage count for the zb arm: the largest candidate whose
+    groups still hold >= 2 blocks (the zb bit-identity constraint) —
+    V=1 always qualifies on the 8-block model at 2/4 ways."""
+    for v in (PP_VIRTUAL_STAGES, 1):
+        if PP_NUM_BLOCKS % (ways * v) == 0 \
+                and PP_NUM_BLOCKS // (ways * v) >= 2:
+            return v
+    return 1
+
+
+_overlap_facts_cache: dict = {}
+
+
+def _overlap_analytic_facts(ways: int, d: int) -> dict:
+    """The overlap phase's chip-free facts: per-schedule useful-tick
+    fractions at ONE shared (K, M, V) config (so the zb-vs-interleaved
+    comparison is apples-to-apples), and the ZeRO exposed-comm bytes
+    serial vs overlapped for the flagship CNN. Cached per process (the
+    efficiency_phase pattern): the degraded record and the test suite
+    both drive this repeatedly."""
+    key = (max(2, int(ways)), max(2, int(d)), PP_NUM_BLOCKS)
+    hit = _overlap_facts_cache.get(key)
+    if hit is not None:
+        return dict(hit)
+    try:
+        from distributed_tensorflow_tpu.models import DeepCNN
+        from distributed_tensorflow_tpu.parallel.pp_schedule import (
+            build_zb_schedule,
+            schedule_useful_fraction,
+        )
+        from distributed_tensorflow_tpu.parallel.zero import (
+            n_buckets,
+            zero_exposed_comm_bytes,
+            zero_memory_budget,
+        )
+
+        ways = max(2, int(ways))
+        d = max(2, int(d))
+        v = _pp_zb_virtual_stages(ways)
+        zb = build_zb_schedule(ways, ways, v)
+        out = {
+            "pp_overlap_stages": ways,
+            "pp_overlap_microbatches": ways,
+            "pp_zb_virtual_stages": v,
+            "pp_gpipe_useful_tick_fraction": round(
+                schedule_useful_fraction("gpipe", ways, ways, 1), 4),
+            "pp_interleaved_useful_tick_fraction": round(
+                schedule_useful_fraction("interleaved", ways, ways, v), 4),
+            "pp_zb_useful_tick_fraction": round(
+                zb.useful_tick_fraction, 4),
+            "pp_zb_ticks": zb.num_ticks,
+        }
+        model = DeepCNN(compute_dtype=jnp.bfloat16)
+        from distributed_tensorflow_tpu.training import adam
+
+        g = zero_memory_budget(model, adam(1e-3), d)["param_bytes"]
+        out.update({
+            "zero_overlap_bucket_mb": OVERLAP_BUCKET_MB,
+            "zero_overlap_buckets": n_buckets(model, d,
+                                              OVERLAP_BUCKET_MB),
+        })
+        for lv in (1, 3):
+            out[f"zero{lv}_exposed_comm_bytes_serial"] = \
+                zero_exposed_comm_bytes(g, g, lv, d, False,
+                                        OVERLAP_BUCKET_MB)
+            out[f"zero{lv}_exposed_comm_bytes_overlap"] = \
+                zero_exposed_comm_bytes(g, g, lv, d, True,
+                                        OVERLAP_BUCKET_MB)
+        _overlap_facts_cache[key] = dict(out)
+        return out
+    except Exception as e:  # never kill the record over the accounting
+        return {"pp_overlap_stages": None,
+                "pp_overlap_microbatches": None,
+                "pp_zb_virtual_stages": None,
+                "pp_zb_useful_tick_fraction": None,
+                "pp_interleaved_useful_tick_fraction": None,
+                "pp_gpipe_useful_tick_fraction": None,
+                "pp_zb_ticks": None,
+                "zero_overlap_bucket_mb": None,
+                "zero_overlap_buckets": None,
+                "zero1_exposed_comm_bytes_serial": None,
+                "zero1_exposed_comm_bytes_overlap": None,
+                "zero3_exposed_comm_bytes_serial": None,
+                "zero3_exposed_comm_bytes_overlap": None,
+                "overlap_facts_error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def overlap_phase(ds, n_chips) -> dict:
+    """Same-session A/B of the r14 stall killers. PP half: gpipe vs
+    interleaved vs zero-bubble (--pp_schedule) on the 8-block model
+    over the device-resident sampler — identical math (bit-identical
+    trajectories, tests/test_pp_zb.py), only the tick schedule changes.
+    ZeRO half: --zero_overlap on vs off at levels 1 and 3 on the
+    flagship CNN — identical math again (bucketed collectives + the
+    level-3 prefetched gather). Analytic facts (per-schedule
+    useful-tick fractions, exposed-comm bytes) always recorded; the
+    measured rates need a multi-chip mesh and stay null without one."""
+    ways = _ppep_model_ways(n_chips, PP_NUM_BLOCKS)
+    out = _overlap_analytic_facts(ways or 2, n_chips)
+    out.update({k: None for k in _OVERLAP_RATE_KEYS})
+    if n_chips < 2:
+        out["overlap_skipped"] = "needs a >1-chip mesh"
+        return out
+
+    from distributed_tensorflow_tpu.data.device_data import put_device_data
+    from distributed_tensorflow_tpu.data.lm import LMDataSet
+    from distributed_tensorflow_tpu.models import DeepCNN
+    from distributed_tensorflow_tpu.models.transformer import TransformerLM
+    from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
+    from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS
+    from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+        shard_state_pp,
+    )
+    from distributed_tensorflow_tpu.parallel.zero import shard_state_zero
+    from distributed_tensorflow_tpu.training import adam, create_train_state
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_pp_device_train_step,
+        make_zero_device_train_step,
+    )
+
+    if ways:
+        mesh = make_mesh(MeshSpec(data=-1, model=ways))
+        data_ways = mesh.shape[DATA_AXIS]
+        batch = PP_EP_BATCH_PER_DATA_WAY * data_ways
+        model = TransformerLM(
+            vocab_size=PP_EP_VOCAB, seq_len=PP_EP_SEQ_LEN,
+            d_model=PP_EP_D_MODEL, num_heads=4,
+            num_blocks=PP_NUM_BLOCKS, compute_dtype=jnp.bfloat16)
+        opt = adam(1e-3)
+        lm = LMDataSet(PP_EP_SPLIT, seq_len=PP_EP_SEQ_LEN,
+                       vocab_size=PP_EP_VOCAB, seed=0)
+        data = put_device_data(lm, mesh, data_sharded=True)
+        v_zb = _pp_zb_virtual_stages(ways)
+        arms = [("gpipe", 1), ("interleaved", v_zb), ("zb", v_zb)]
+        rates = {}
+        for sched, v in arms:
+            # fresh base per arm (see pp_device_phase: device_put can
+            # alias host leaves the donated step then deletes)
+            base = create_train_state(model, opt, seed=0)
+            state = shard_state_pp(base, mesh, virtual_stages=v)
+            fn = make_pp_device_train_step(
+                model, opt, mesh, batch, ways, keep_prob=1.0,
+                chunk=PP_EP_CHUNK, virtual_stages=v, schedule=sched)
+            dt = _time_resident_chunks(fn, state, data, PP_EP_CHUNK,
+                                       OVERLAP_TIMED_CHUNKS, n_chips)
+            rates[sched] = (OVERLAP_TIMED_CHUNKS * PP_EP_CHUNK * batch
+                            / dt / n_chips)
+        for sched in ("gpipe", "interleaved", "zb"):
+            out[f"overlap_pp_{sched}_images_per_sec_per_chip"] = round(
+                rates[sched], 1)
+        out["pp_zb_speedup_vs_interleaved"] = round(
+            rates["zb"] / rates["interleaved"], 3)
+    else:
+        out["overlap_pp_skipped"] = (f"no 2/4-way model axis over "
+                                     f"{n_chips} chip(s)")
+
+    cnn = DeepCNN(compute_dtype=jnp.bfloat16)
+    opt = adam(1e-3)
+    mesh = make_mesh()
+    batch_size = PER_CHIP_BATCH * n_chips
+    data = put_device_data(ds.train, mesh)
+    for level in (1, 3):
+        for overlap in (False, True):
+            state = shard_state_zero(
+                create_train_state(cnn, opt, seed=0), mesh, level)
+            fn = make_zero_device_train_step(
+                cnn, opt, mesh, level, batch_size, keep_prob=0.75,
+                chunk=CHUNK, overlap=overlap,
+                bucket_mb=OVERLAP_BUCKET_MB)
+            dt = _time_resident_chunks(fn, state, data, CHUNK,
+                                       OVERLAP_TIMED_CHUNKS, n_chips)
+            rate = (OVERLAP_TIMED_CHUNKS * CHUNK * batch_size
+                    / dt / n_chips)
+            key = "overlap" if overlap else "serial"
+            out[f"zero{level}_{key}_images_per_sec_per_chip"] = round(
+                rate, 1)
+            del state
+    return out
+
+
 def recovery_phase() -> dict:
     """Verified-restore drill (r8): save two checkpoints of a small host
     state, TEAR the newest mid-file (the machine-crash signature the
@@ -1626,6 +1835,11 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
                 "dp_live_bytes_per_chip":
                     zmem["dp_total_bytes_per_chip_analytic"],
                 "zero_live_bytes_source": "analytic"})
+    # r14: the overlap phase's schedule fractions and exposed-comm
+    # bytes are analytic too — non-null through outages, per the bench
+    # contract (the A/B rates need chips and stay null)
+    out.update(_overlap_analytic_facts(2, 2))
+    out.update({k: None for k in _OVERLAP_RATE_KEYS})
     # the restore-ladder, serving, and telemetry drills are host-only:
     # the recovery_*/serving_*/telemetry_* fields stay non-null in
     # EVERY record, outage or not (the telemetry A/B needs the chip
@@ -1743,6 +1957,9 @@ def _run_phases(out: dict):
     # r10: ZeRO-sharded DP A/B — replicated vs --zero 1, flagship CNN,
     # device-resident input (analytic memory facts + measured rates)
     out.update(dp_zero_phase(ds, n_chips))
+    # r14: the stall killers — pipeline-schedule A/B (gpipe vs
+    # interleaved vs zero-bubble) + ZeRO comm overlap on-vs-off
+    out.update(overlap_phase(ds, n_chips))
     # r8: the verified-restore drill (host-only; also runs in the
     # degraded record so the recovery fields are never null)
     out.update(recovery_phase())

@@ -319,6 +319,19 @@ def define_reference_flags():
                    "checkpoints stay layout-independent. Requires "
                    "num_blocks divisible by model_axis*virtual_stages "
                    "and microbatches divisible by model_axis")
+    DEFINE_string("pp_schedule", "auto", "Pipeline tick schedule for "
+                  "--pipeline: auto (default: interleaved when "
+                  "--virtual_stages > 1, else gpipe — the pre-flag "
+                  "behavior), gpipe, interleaved, or zb (zero-bubble, "
+                  "ZB-H1 family: backward splits into activation-grad "
+                  "B and weight-grad W ticks and the deferred W ticks "
+                  "fill the cooldown bubble — useful-tick fraction "
+                  "strictly above interleaved at the same layout). "
+                  "All three compute the same function: trajectories "
+                  "are bit-identical across schedules at the same "
+                  "(K, M, V) and checkpoints restore across them "
+                  "bitwise. zb composes with --virtual_stages and "
+                  "needs >= 2 blocks per virtual-stage group")
     DEFINE_integer("moe_experts", 0, "If > 0, the LM's MLPs become "
                    "top-1 Switch mixture-of-experts layers with this "
                    "many experts (ops/moe.py); the training loss adds "
@@ -355,6 +368,23 @@ def define_reference_flags():
                    "exclusive with the model-axis strategies "
                    "(--pipeline/--seq_parallel/--expert_parallel/"
                    "--model_axis>1) and ps mode")
+    DEFINE_boolean("zero_overlap", False, "ZeRO comm/compute overlap "
+                   "(requires --zero 1|3): grads reduce-scatter in "
+                   "--zero_bucket_mb buckets that issue as backward "
+                   "produces leaves (instead of one serial flat "
+                   "scatter at the end), and — at level 3 — the param "
+                   "all_gather is prefetched one step ahead inside the "
+                   "--device_data scan (double-buffered; XLA's async "
+                   "collectives hide it behind compute) and reused by "
+                   "forward AND backward, cutting the wire from "
+                   "|G|+2|P| to |G|+|P|. Trajectories stay "
+                   "bit-identical to the serial ZeRO path (same "
+                   "padding, same chunk ownership)")
+    DEFINE_float("zero_bucket_mb", 4.0, "Bucket size in MB for "
+                 "--zero_overlap's bucketed reduce-scatter/all-gather "
+                 "(the comm-latency/overlap-granularity knob): leaves "
+                 "group in canonical order until a bucket exceeds "
+                 "this, one collective per bucket")
     DEFINE_string("prng", "threefry", "PRNG implementation: threefry "
                   "(default, partition-invariant) or rbg (hardware RNG — "
                   "measured ~4% faster steps on TPU; dropout masks and "
@@ -657,6 +687,24 @@ def _validate_zero_flags(values: dict):
             f"optimizer state over the data axis) or 3 (shard the params "
             f"too, FSDP-style); level 2 (grad persistence sharding) does "
             f"not exist in this build — grads are already transient")
+    overlap = bool(values.get("zero_overlap"))
+    bucket = values.get("zero_bucket_mb")
+    if bucket is not None and not 0 < float(bucket) <= 1024:
+        raise ValueError(
+            f"--zero_bucket_mb={bucket} must be in (0, 1024] MB (one "
+            f"collective per bucket; 0 or negative would bucket "
+            f"nothing, >1 GB is one flat scatter by another name)")
+    if overlap and z == 0:
+        raise ValueError(
+            "--zero_overlap only applies to --zero 1|3 (it reschedules "
+            "the ZeRO collectives); without --zero it would silently "
+            "change nothing — drop it or pick a --zero level")
+    if not overlap and bucket is not None and float(bucket) != 4.0:
+        raise ValueError(
+            f"--zero_bucket_mb={bucket} only applies with "
+            f"--zero_overlap (it sizes the overlap pattern's buckets); "
+            f"without it the flag would silently change nothing — drop "
+            f"it or add --zero_overlap")
     if z == 0:
         return
     for flag, what in (("pipeline", "pipeline stages"),
@@ -841,6 +889,11 @@ def _validate_pipeline_flags(values: dict):
     command line with a message that names the flags instead. The
     library-level checks stay (non-CLI callers are still protected);
     this is the fail-fast front door."""
+    from distributed_tensorflow_tpu.parallel.pp_schedule import (
+        PP_SCHEDULES,
+        normalize_pp_schedule,
+    )
+
     raw_v = values.get("virtual_stages")
     v = 1 if raw_v is None else int(raw_v)
     micro_flag = int(values.get("pp_microbatches") or 0)
@@ -849,6 +902,11 @@ def _validate_pipeline_flags(values: dict):
     if micro_flag < 0:
         raise ValueError(f"--pp_microbatches={micro_flag} must be >= 0 "
                          f"(0 = the stage count)")
+    raw_sched = (values.get("pp_schedule") or "auto").strip().lower()
+    if raw_sched not in PP_SCHEDULES:
+        raise ValueError(
+            f"--pp_schedule={raw_sched!r} must be one of "
+            f"{', '.join(PP_SCHEDULES)}")
     if not values.get("pipeline"):
         if v > 1:
             raise ValueError(
@@ -856,7 +914,20 @@ def _validate_pipeline_flags(values: dict):
                 f"interleaved schedule splits pipeline stages); without "
                 f"--pipeline it would silently change nothing — drop it "
                 f"or add --pipeline")
+        if raw_sched != "auto":
+            raise ValueError(
+                f"--pp_schedule={raw_sched} only applies to --pipeline "
+                f"(it picks the pipeline tick schedule); without "
+                f"--pipeline it would silently change nothing — drop it "
+                f"or add --pipeline")
         return
+    # gpipe x virtual_stages>1 contradiction surfaces here with the
+    # flags named; zb's V interaction is checked against the layout
+    # below (same rounds rule as interleaved, plus >= 2 blocks/group)
+    try:
+        sched = normalize_pp_schedule(raw_sched, v)
+    except ValueError as e:
+        raise ValueError(f"--pp_schedule: {e}") from None
     k = int(values.get("model_axis") or 1)
     micro = micro_flag or k
     batch = int(values.get("batch_size") or 0)
@@ -879,3 +950,11 @@ def _validate_pipeline_flags(values: dict):
                 f"microbatches in rounds of the stage count: "
                 f"--pp_microbatches={micro} must be divisible by "
                 f"--model_axis={k}")
+        if sched == "zb" and nb and nb // (k * v) < 2:
+            raise ValueError(
+                f"--pp_schedule=zb needs >= 2 blocks per virtual-stage "
+                f"group (the inner block scan's loop boundary is what "
+                f"keeps zb bit-identical to gpipe/interleaved): "
+                f"--num_blocks={nb} over --model_axis={k} x "
+                f"--virtual_stages={v} leaves {nb // (k * v)} block(s) "
+                f"per group — raise --num_blocks or lower the split")

@@ -71,9 +71,15 @@ from distributed_tensorflow_tpu.models.transformer import (
 from distributed_tensorflow_tpu.ops import nn
 from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from distributed_tensorflow_tpu.parallel.pp_schedule import (
+    ZB_B,
+    ZB_F,
+    ZB_W,
     block_permutation,
     build_pp_schedule,
+    build_zb_schedule,
+    normalize_pp_schedule,
     validate_pp_layout,
+    validate_zb_layout,
 )
 from distributed_tensorflow_tpu.training.train_state import (
     TrainState,
@@ -280,12 +286,15 @@ def _attn_for(model):
 
 def _pp_step_fn(model, optimizer, mesh, microbatches: int,
                 keep_prob: float, grad_transform,
-                virtual_stages: int = 1):
+                virtual_stages: int = 1, schedule: str = "auto"):
     """Validate the PP configuration and build the raw per-shard step
     ``(state, (x, y)) -> (state, metrics)`` — the body both the host-fed
     wrapper (``make_pp_train_step``) and the device-resident sampler
     (``training/device_step.make_pp_device_train_step``) run inside
-    ``shard_map``."""
+    ``shard_map``. ``schedule`` picks the tick table: gpipe /
+    interleaved differentiate the forward scan (AD is the backward
+    schedule), zb runs the explicit F/B/W zero-bubble scan
+    (``_pp_zb_grads``) — identical gradients either way (bit-pinned)."""
     if getattr(model, "seq_axis", None) is not None:
         raise ValueError("pipeline parallelism stages BLOCKS; it does "
                          "not compose with seq_axis (ring attention) — "
@@ -298,8 +307,15 @@ def _pp_step_fn(model, optimizer, mesh, microbatches: int,
     k_stages = mesh.shape[MODEL_AXIS]
     m = int(microbatches)
     v_stages = int(virtual_stages)
+    sched_name = normalize_pp_schedule(schedule, v_stages)
     validate_pp_layout(model.num_blocks, k_stages, v_stages,
                        microbatches=m)
+    if sched_name == "zb":
+        # zb-specific constraints up front: >= 2 blocks per group (the
+        # bit-identity boundary) and a buildable F/B/W table
+        validate_zb_layout(model.num_blocks, k_stages, v_stages,
+                           microbatches=m)
+        build_zb_schedule(k_stages, m, v_stages)
     cd = model.compute_dtype
 
     def step(state: TrainState, batch):
@@ -311,11 +327,17 @@ def _pp_step_fn(model, optimizer, mesh, microbatches: int,
         rng, sub = jax.random.split(state.rng)
         sub = jax.random.fold_in(sub, lax.axis_index(DATA_AXIS))
 
-        def loss_fn(params):
-            return _pp_loss(model, params, x, y, sub, m, k_stages,
-                            s_idx, keep_prob, cd, v_stages)
+        if sched_name == "zb":
+            grads, loss, acc = _pp_zb_grads(
+                model, state.params, x, y, sub, m, k_stages, s_idx,
+                keep_prob, cd, v_stages)
+        else:
+            def loss_fn(params):
+                return _pp_loss(model, params, x, y, sub, m, k_stages,
+                                s_idx, keep_prob, cd, v_stages)
 
-        grads, (loss, acc) = jax.grad(loss_fn, has_aux=True)(state.params)
+            grads, (loss, acc) = jax.grad(loss_fn, has_aux=True)(
+                state.params)
         # the differentiated loss was LOCAL (nonzero on the last stage
         # only): psum totals it for reporting, and the same psum totals
         # the replicated leaves' per-stage partials. Stage-sharded block
@@ -346,7 +368,8 @@ def _pp_step_fn(model, optimizer, mesh, microbatches: int,
 
 def make_pp_train_step(model, optimizer, mesh, microbatches: int,
                        keep_prob: float = 1.0, donate: bool = True,
-                       grad_transform=None, virtual_stages: int = 1):
+                       grad_transform=None, virtual_stages: int = 1,
+                       schedule: str = "auto"):
     """Compiled pipeline-parallel train step for ``TransformerLM``:
     (PP-layout state, staged batch) -> (state, metrics).
 
@@ -358,10 +381,13 @@ def make_pp_train_step(model, optimizer, mesh, microbatches: int,
     state stacked by ``shard_state_pp(..., virtual_stages=V)`` —
     bit-identical trajectories to V=1, in M*V + K - 1 ticks of
     1/V-sized block groups instead of M + K - 1 full-stage ticks.
-    Matches ``compute_grads(accum_steps=M)`` trajectories (the
-    per-microbatch rng fold is the same)."""
+    ``schedule="zb"`` runs the zero-bubble F/B/W table on the SAME
+    stacked layout (any V) — trajectories stay bit-identical to
+    gpipe/interleaved; only the tick order changes. Matches
+    ``compute_grads(accum_steps=M)`` trajectories (the per-microbatch
+    rng fold is the same)."""
     step = _pp_step_fn(model, optimizer, mesh, microbatches, keep_prob,
-                       grad_transform, virtual_stages)
+                       grad_transform, virtual_stages, schedule)
     data_spec = (P(DATA_AXIS, None), P(DATA_AXIS, None))
     cache: dict = {}
 
@@ -378,6 +404,48 @@ def make_pp_train_step(model, optimizer, mesh, microbatches: int,
         return fn(state, batch)
 
     return call
+
+
+def _embed_fn(tok, pos, ids, cd):
+    """Token embedding + learned positions — the ONE embed both the
+    AD-schedules' tick body and the zb W(m, 0) re-linearization run, so
+    the two paths cannot diverge bitwise."""
+    h = jnp.take(tok, ids, axis=0) + pos.astype(tok.dtype)
+    return h.astype(cd) if cd is not None else h
+
+
+def _group_fwd_fn(blk_fn, attn, cd, blk, h):
+    """One virtual-stage block group's forward: the inner scan over its
+    (already gathered) stacked block leaves — shared by every schedule
+    (and by the zb B/W vjp re-linearizations). The scan's loop boundary
+    is ALSO the zb bit-identity mechanism: a length >= 2 loop body
+    compiles as an isolated computation in both the AD backward and the
+    explicit vjps, so the weight-grad contractions hit identical
+    kernels; at length 1 XLA simplifies the loop away and fuses the zb
+    branch's forward RECOMPUTE into the contraction (AD reads saved
+    residuals instead), wobbling the projection grads by an ulp — which
+    is why ``validate_zb_layout`` requires >= 2 blocks per group."""
+    def body(hh, b):
+        return blk_fn(hh, b, attn, cd), None
+
+    h, _ = lax.scan(body, h, blk)
+    return h
+
+
+def _head_loss_fn(model, lnf, head, keep_prob, cd, h, targets, key):
+    """Final-stage LN -> dropout -> LM head -> (loss, accuracy) —
+    parametrized by the head weights so the zb W(m, KV-1) tick can
+    differentiate it; the AD schedules close over the same function."""
+    h = _layernorm(h, lnf["g"], lnf["b"])
+    h = nn.dropout(h, keep_prob, key, deterministic=keep_prob >= 1.0)
+    if getattr(model, "ce_block", None):
+        return nn.streamed_softmax_ce_head(
+            h, head["w"], head["b"], targets,
+            block=model.ce_block, compute_dtype=cd)
+    logits = nn.dense(h, head["w"], head["b"],
+                      compute_dtype=cd).astype(jnp.float32)
+    return (nn.softmax_cross_entropy(logits, targets),
+            nn.accuracy(logits, targets))
 
 
 def _pp_loss(model, params, x, y, sub, m, k_stages, s_idx, keep_prob, cd,
@@ -416,29 +484,15 @@ def _pp_loss(model, params, x, y, sub, m, k_stages, s_idx, keep_prob, cd,
         blocks)
 
     def embed(ids):
-        h = jnp.take(tok, ids, axis=0) + pos.astype(tok.dtype)
-        return h.astype(cd) if cd is not None else h
+        return _embed_fn(tok, pos, ids, cd)
 
     def group_fwd(h, v):
         blk = jax.tree.map(lambda a: a[v], vblocks)
-
-        def body(h, b):
-            return blk_fn(h, b, attn, cd), None
-        h, _ = lax.scan(body, h, blk)
-        return h
+        return _group_fwd_fn(blk_fn, attn, cd, blk, h)
 
     def head_loss(h, targets, key):
-        h = _layernorm(h, lnf["g"], lnf["b"])
-        h = nn.dropout(h, keep_prob, key,
-                       deterministic=keep_prob >= 1.0)
-        if getattr(model, "ce_block", None):
-            return nn.streamed_softmax_ce_head(
-                h, head["w"], head["b"], targets,
-                block=model.ce_block, compute_dtype=cd)
-        logits = nn.dense(h, head["w"], head["b"],
-                          compute_dtype=cd).astype(jnp.float32)
-        return (nn.softmax_cross_entropy(logits, targets),
-                nn.accuracy(logits, targets))
+        return _head_loss_fn(model, lnf, head, keep_prob, cd, h,
+                             targets, key)
 
     def tick(carry, t):
         # embed/head are GATED with lax.cond on the scheduled unit, not
@@ -476,6 +530,222 @@ def _pp_loss(model, params, x, y, sub, m, k_stages, s_idx, keep_prob, cd,
     return jnp.sum(losses) / m, (jnp.sum(losses) / m, jnp.sum(accs) / m)
 
 
+def _pp_zb_grads(model, params, x, y, sub, m, k_stages, s_idx, keep_prob,
+                 cd, v_stages: int = 1):
+    """The zero-bubble pipelined forward+backward, written EXPLICITLY:
+    one ``lax.scan`` over the combined F/B/W tick table
+    (``pp_schedule.build_zb_schedule``) instead of reverse-mode AD of
+    the forward scan. Returns ``(grads, local_loss, local_acc)`` with
+    the same contracts as differentiating ``_pp_loss``: stage-sharded
+    block grads are exact partials, replicated-leaf grads are nonzero
+    only on the stages that use them (one outer psum totals them), the
+    loss is LOCAL (nonzero on the last stage only).
+
+    Tick semantics (the table's arrival columns route the ring):
+    - **F**: forward one block group from the stashed input (stage 0
+      group 0 embeds and stashes the embed output — its W needs it),
+      send the activation on the forward ring.
+    - **B**: activation grad. The last unit linearizes
+      group_fwd∘head_loss from the stashed input and pulls (dh, loss,
+      acc) out of one vjp (its forward IS the linearization — no
+      separate F tick); middle units vjp group_fwd w.r.t. the input at
+      the stashed cotangent. dh rides the reverse ring.
+    - **W**: weight grad, deferred into the cooldown: vjp the same
+      unit w.r.t. its PARAMS from the stashed (input, cotangent) pair
+      (the first unit folds the embed backward in; the last the head
+      backward), written into a per-microbatch buffer.
+
+    Bit-identity with the AD schedules rests on three pinned facts:
+    splitting one joint vjp into activation-only + params-only halves
+    reproduces the joint backward bitwise (same primitive rules, same
+    operands); re-linearizing from the stashed input reproduces the
+    saved-residual backward bitwise (deterministic ops, identical
+    inputs); and AD-of-scan accumulates closure-constant cotangents in
+    REVERSE tick order — so the per-microbatch buffers fold in
+    DESCENDING m after the scan, reproducing AD's addition order
+    exactly. The buffers are the schedule's memory price: W deferral
+    keeps M per-microbatch weight-grad slabs live within the step
+    (they never cross the optimizer update — the fold runs before it).
+    """
+    tok, pos = params["tok"], params["pos"]
+    blocks = params["blocks"]
+    lnf, head = params["ln_f"], params["head"]
+    mb = x.shape[0] // m
+    xm = x.reshape(m, mb, x.shape[1])
+    ym = y.reshape(m, mb, y.shape[1])
+    fwd_perm = [(i, (i + 1) % k_stages) for i in range(k_stages)]
+    bwd_perm = [(i, (i - 1) % k_stages) for i in range(k_stages)]
+    attn = _attn_for(model)
+    blk_fn = _transformer_block
+    if getattr(model, "remat", False):
+        blk_fn = jax.checkpoint(_transformer_block, static_argnums=(2, 3))
+    v = int(v_stages)
+    sched = build_zb_schedule(k_stages, m, v)
+    kind_tbl = jnp.asarray(sched.kind)
+    mb_tbl = jnp.asarray(sched.micro_index)
+    ch_tbl = jnp.asarray(sched.chunk_index)
+    fiv = jnp.asarray(sched.fwd_in_valid)
+    fim = jnp.asarray(sched.fwd_in_micro)
+    fic = jnp.asarray(sched.fwd_in_chunk)
+    biv = jnp.asarray(sched.bwd_in_valid)
+    bim = jnp.asarray(sched.bwd_in_micro)
+    bic = jnp.asarray(sched.bwd_in_chunk)
+
+    vblocks = jax.tree.map(
+        lambda a: a.reshape(v, a.shape[0] // v, *a.shape[1:]), blocks)
+    hdt = cd if cd is not None else jnp.float32
+    act = (mb, x.shape[1], model.d_model)
+    # AD seeds each unit's loss cotangent with d(sum(losses)/m) = 1/m
+    seed = jnp.ones((), jnp.float32) / m
+    gfwd = lambda blk, h: _group_fwd_fn(blk_fn, attn, cd, blk, h)
+    hloss = lambda ln, hd, h, tgt, key: _head_loss_fn(
+        model, ln, hd, keep_prob, cd, h, tgt, key)
+    zbuf = lambda tree: jax.tree.map(
+        lambda a: jnp.zeros((m,) + a.shape, a.dtype), tree)
+
+    carry0 = (
+        jnp.zeros(act, hdt),              # forward ring payload
+        jnp.zeros(act, hdt),              # backward (cotangent) payload
+        jnp.zeros((m, v) + act, hdt),     # stash_h: unit inputs
+        jnp.zeros((m, v) + act, hdt),     # stash_c: unit cotangents
+        zbuf(vblocks),                    # wbuf [M, V, L/V, ...]
+        (zbuf(tok), zbuf(pos)),           # embed grads per microbatch
+        (zbuf(lnf), zbuf(head)),          # head grads per microbatch
+    )
+
+    def tick(carry, t):
+        h_slot, c_slot, stash_h, stash_c, wbuf, embbuf, headbuf = carry
+        # arrivals: payloads ppermuted at the end of tick t-1 land now
+        stash_h = lax.cond(
+            fiv[t, s_idx],
+            lambda sh: sh.at[fim[t, s_idx], fic[t, s_idx]].set(h_slot),
+            lambda sh: sh, stash_h)
+        stash_c = lax.cond(
+            biv[t, s_idx],
+            lambda sc: sc.at[bim[t, s_idx], bic[t, s_idx]].set(c_slot),
+            lambda sc: sc, stash_c)
+        m_i = mb_tbl[t, s_idx]
+        v_i = ch_tbl[t, s_idx]
+        is_first = (s_idx == 0) & (v_i == 0)
+        is_loss = (s_idx == k_stages - 1) & (v_i == v - 1)
+        blk = jax.tree.map(lambda a: a[v_i], vblocks)
+        h_in = stash_h[m_i, v_i]
+        cot = stash_c[m_i, v_i]
+        key = jax.random.fold_in(sub, m_i)
+        zero_act = jnp.zeros(act, hdt)
+        zero32 = jnp.float32(0.0)
+
+        def do_noop(ops):
+            stash_h, wbuf, embbuf, headbuf = ops
+            return (zero_act, zero_act, stash_h, wbuf, embbuf, headbuf,
+                    zero32, zero32)
+
+        def do_f(ops):
+            stash_h, wbuf, embbuf, headbuf = ops
+            h0 = lax.cond(
+                is_first,
+                lambda: _embed_fn(tok, pos, xm[m_i], cd).astype(hdt),
+                lambda: h_in)
+            # the embed unit's W re-linearizes from the raw ids, but its
+            # B-consumers downstream need the stashed input like anyone
+            stash_h = lax.cond(is_first,
+                               lambda sh: sh.at[m_i, v_i].set(h0),
+                               lambda sh: sh, stash_h)
+            return (gfwd(blk, h0), zero_act, stash_h, wbuf, embbuf,
+                    headbuf, zero32, zero32)
+
+        def do_b(ops):
+            stash_h, wbuf, embbuf, headbuf = ops
+
+            def loss_b():
+                f = lambda hh: hloss(lnf, head, gfwd(blk, hh), ym[m_i],
+                                     key)
+                (l, a), vjp = jax.vjp(f, h_in)
+                (dh,) = vjp((seed, zero32))
+                return dh, l, a
+
+            def mid_b():
+                _, vjp = jax.vjp(lambda hh: gfwd(blk, hh), h_in)
+                (dh,) = vjp(cot)
+                return dh, zero32, zero32
+
+            dh, l, a = lax.cond(is_loss, loss_b, mid_b)
+            return (zero_act, dh, stash_h, wbuf, embbuf, headbuf, l, a)
+
+        def do_w(ops):
+            stash_h, wbuf, embbuf, headbuf = ops
+            put = lambda buf, g: jax.tree.map(
+                lambda b, gg: b.at[m_i].set(gg), buf, g)
+            putw = lambda buf, g: jax.tree.map(
+                lambda b, gg: b.at[m_i, v_i].set(gg), buf, g)
+
+            def w_first(bufs):
+                wbuf, embbuf, headbuf = bufs
+                f = lambda tk, ps, bb: gfwd(
+                    bb, _embed_fn(tk, ps, xm[m_i], cd).astype(hdt))
+                _, vjp = jax.vjp(f, tok, pos, blk)
+                dtok, dpos, dblk = vjp(cot)
+                return (putw(wbuf, dblk),
+                        (put(embbuf[0], dtok), put(embbuf[1], dpos)),
+                        headbuf)
+
+            def w_loss(bufs):
+                wbuf, embbuf, headbuf = bufs
+                f = lambda bb, ln, hd: hloss(ln, hd, gfwd(bb, h_in),
+                                             ym[m_i], key)
+                _, vjp = jax.vjp(f, blk, lnf, head)
+                dblk, dlnf, dhead = vjp((seed, zero32))
+                return (putw(wbuf, dblk), embbuf,
+                        (put(headbuf[0], dlnf), put(headbuf[1], dhead)))
+
+            def w_mid(bufs):
+                wbuf, embbuf, headbuf = bufs
+                _, vjp = jax.vjp(lambda bb: gfwd(bb, h_in), blk)
+                (dblk,) = vjp(cot)
+                return putw(wbuf, dblk), embbuf, headbuf
+
+            wbuf, embbuf, headbuf = lax.cond(
+                is_first, w_first,
+                lambda bufs: lax.cond(is_loss, w_loss, w_mid, bufs),
+                (wbuf, embbuf, headbuf))
+            return (zero_act, zero_act, stash_h, wbuf, embbuf, headbuf,
+                    zero32, zero32)
+
+        ops = (stash_h, wbuf, embbuf, headbuf)
+        branches = [do_noop] * 4
+        branches[ZB_F], branches[ZB_B], branches[ZB_W] = do_f, do_b, do_w
+        h_out, c_out, stash_h, wbuf, embbuf, headbuf, l, a = lax.switch(
+            kind_tbl[t, s_idx], branches, ops)
+        h_next = lax.ppermute(h_out, MODEL_AXIS, fwd_perm)
+        c_next = lax.ppermute(c_out, MODEL_AXIS, bwd_perm)
+        return (h_next, c_next, stash_h, stash_c, wbuf, embbuf,
+                headbuf), (l, a)
+
+    carry, (losses, accs) = lax.scan(tick, carry0,
+                                     jnp.arange(sched.num_ticks))
+    wbuf, embbuf, headbuf = carry[4], carry[5], carry[6]
+
+    def fold_desc(buf):
+        # AD-of-scan adds closure-constant cotangents in reverse tick
+        # order — descending m per slot; reproduce that fold bitwise
+        out = jnp.zeros(buf.shape[1:], buf.dtype)
+        for mm in range(m - 1, -1, -1):
+            out = out + buf[mm]
+        return out
+
+    grads = {
+        "tok": fold_desc(embbuf[0]),
+        "pos": fold_desc(embbuf[1]),
+        "blocks": jax.tree.map(
+            lambda b: fold_desc(b).reshape(b.shape[1] * b.shape[2],
+                                           *b.shape[3:]),
+            wbuf),
+        "ln_f": jax.tree.map(fold_desc, headbuf[0]),
+        "head": jax.tree.map(fold_desc, headbuf[1]),
+    }
+    return grads, jnp.sum(losses) / m, jnp.sum(accs) / m
+
+
 def stage_batch_pp(mesh, batch):
     """(x, y) -> device arrays: batch split over "data", REPLICATED over
     the stage axis (ids are tiny; every stage sees the full token ids
@@ -490,21 +760,35 @@ def stage_batch_pp(mesh, batch):
 
 
 def pp_comm_rows(act_bytes_per_microbatch: int, k_stages: int,
-                 microbatches: int, virtual_stages: int = 1) -> list[dict]:
+                 microbatches: int, virtual_stages: int = 1,
+                 schedule: str = "auto") -> list[dict]:
     """Static per-step boundary-transfer bytes for the stage ring — the
     comm ledger's PP rows. Each microbatch's activation ppermutes
     through ``K*V - 1`` boundary hops forward (the interleaved schedule
     makes V shorter trips that add up to the same block sequence, plus
     the V-1 wrap-around hops between groups), and the backward routes
     the cotangent through the same hops in reverse. Tiny schedule
-    control traffic and the final metrics pmean are ignored."""
+    control traffic and the final metrics pmean are ignored.
+
+    ``exposed_bytes`` per row is the analytic on-critical-path share:
+    under gpipe/interleaved every hop sits on the tick boundary (the
+    consumer uses it the very next tick), so everything is exposed;
+    under zb the cotangent hops land in a stash and their consumers
+    (B/W ticks) have slack from the deferred-W schedule, so the
+    backward ring prices as overlapped (exposed 0)."""
+    sched = normalize_pp_schedule(schedule, virtual_stages)
     hops = max(0, k_stages * max(1, virtual_stages) - 1)
     fwd = microbatches * hops * act_bytes_per_microbatch
+    bwd_note = ("the transpose routes the same bytes in reverse"
+                if sched != "zb" else
+                "zb: cotangents stash on arrival; deferred-W slack "
+                "hides the hop off the critical path")
     return [
         {"collective": "ppermute(activations, forward)", "axis": "model",
-         "bytes": fwd,
-         "note": f"{microbatches} microbatches x {hops} boundary hops"},
+         "bytes": fwd, "exposed_bytes": fwd,
+         "note": f"{microbatches} microbatches x {hops} boundary hops "
+                 f"({sched})"},
         {"collective": "ppermute(cotangents, backward)", "axis": "model",
-         "bytes": fwd,
-         "note": "the transpose routes the same bytes in reverse"},
+         "bytes": fwd, "exposed_bytes": 0 if sched == "zb" else fwd,
+         "note": bwd_note},
     ]

@@ -32,10 +32,33 @@ performance story:
   ``M*V / (M*V + K - 1)`` = ``M / (M + (K-1)/V)`` — the fill/drain
   bubble shrinks ~V-fold.
 
+- **Zero-bubble** (``--pp_schedule zb``, ZB-H1 family; Qi et al. 2023):
+  the two schedules above describe only the FORWARD scan — their
+  backward is reverse-mode AD of that scan, so the fill/drain bubble is
+  paid twice (once per direction) and cannot be filled: at the tail of
+  the backward nothing is ready except weight gradients, which AD fuses
+  into the same tick as the activation gradient. ZB splits every
+  backward unit into an activation-grad tick **B** (produces the
+  cotangent the PREVIOUS stage is waiting on — on the critical path)
+  and a weight-grad tick **W** (consumes stashed (h_in, cotangent);
+  nothing downstream waits on it), then fills the cooldown bubble with
+  the deferred W ticks. ``build_zb_schedule`` builds the combined
+  [T, K] F/B/W table with a deterministic greedy list scheduler
+  (B > F > W priority — B unblocks the ring, W has no consumers) over
+  the dependency graph; useful-tick fraction = useful cells / (T*K),
+  strictly above the interleaved schedule's at the same (K, M, V).
+  Unit inventory per microbatch: the first group (j=0) has F and W
+  only (its W folds the embed backward in — there is no upstream to
+  send a cotangent to), the last group (j=KV-1) has B and W only (its
+  B linearizes the loss head directly from the stashed input — the
+  separate forward tick would feed nobody), every middle group has all
+  three.
+
 Everything here is host-side numpy: the tables are closed over as
 constants by the compiled step, printed by ``tools/trace_ops.py
 --schedule``, recorded analytically by ``bench.py`` (even when the TPU
-is unreachable), and pinned by tests/test_pp_interleaved.py.
+is unreachable), and pinned by tests/test_pp_interleaved.py +
+tests/test_pp_zb.py.
 """
 
 from __future__ import annotations
@@ -160,6 +183,286 @@ def block_permutation(num_blocks: int, k_stages: int,
             perm[p:p + lv] = np.arange(base, base + lv)
             p += lv
     return perm
+
+
+# tick kinds in a ZBSchedule's ``kind`` table
+ZB_NONE, ZB_F, ZB_B, ZB_W = 0, 1, 2, 3
+
+PP_SCHEDULES = ("auto", "gpipe", "interleaved", "zb")
+
+
+def normalize_pp_schedule(name: str | None, virtual_stages: int) -> str:
+    """The one ``--pp_schedule`` flag->schedule mapping, shared by flag
+    parsing, the step builders, and the comm-ledger rows. ``auto`` (the
+    default) preserves the pre-flag behavior: interleaved when V > 1,
+    gpipe otherwise (the same table — gpipe IS the V=1 special case).
+    Raises ValueError naming the whitelist / the V interaction."""
+    name = (name or "auto").strip().lower()
+    if name not in PP_SCHEDULES:
+        raise ValueError(
+            f"pp_schedule={name!r} must be one of {', '.join(PP_SCHEDULES)}")
+    v = int(virtual_stages)
+    if name == "auto":
+        return "interleaved" if v > 1 else "gpipe"
+    if name == "gpipe" and v > 1:
+        raise ValueError(
+            f"pp_schedule=gpipe is the virtual_stages=1 special case of "
+            f"the interleaved table; with virtual_stages={v} use "
+            f"pp_schedule=interleaved (or zb) or drop --virtual_stages")
+    return name
+
+
+def validate_zb_layout(num_blocks: int, k_stages: int,
+                       virtual_stages: int = 1,
+                       microbatches: int | None = None) -> None:
+    """Layout constraints specific to the zero-bubble schedule, on top
+    of ``validate_pp_layout``: every virtual-stage group must hold at
+    least TWO blocks. The inner block scan's loop boundary is what
+    keeps the zb explicit vjp kernels bit-aligned with the AD
+    schedules' (a length-1 scan gets simplified away and XLA fuses the
+    zb branch's forward recompute into the weight-grad contraction,
+    wobbling it by an ulp) — so a 1-block group would silently break
+    the bit-identity contract instead of the schedule."""
+    validate_pp_layout(num_blocks, k_stages, virtual_stages,
+                       microbatches=microbatches)
+    k, v = int(k_stages), int(virtual_stages)
+    if num_blocks // (k * v) < 2:
+        raise ValueError(
+            f"the zero-bubble schedule needs >= 2 blocks per virtual-"
+            f"stage group to stay bit-identical to gpipe/interleaved "
+            f"(the inner block scan's loop boundary pins the backward "
+            f"kernels): num_blocks={num_blocks} over {k} stages x {v} "
+            f"group(s) leaves {num_blocks // (k * v)} block(s) per "
+            f"group — use more blocks or fewer stages/groups")
+
+
+@dataclass(frozen=True)
+class ZBSchedule:
+    """The combined forward/backward tick table for the zero-bubble
+    schedule. ``kind[t, s]`` is ZB_NONE/ZB_F/ZB_B/ZB_W; ``micro_index``
+    / ``chunk_index`` give the cell's work unit (clipped to 0 on bubble
+    cells — the masked computation still needs in-range indices). The
+    ``fwd_in_*`` / ``bwd_in_*`` tables route ring ARRIVALS: a payload
+    ppermuted at the end of tick t-1 lands at tick t and is stashed
+    into slot (micro, chunk) when valid — ZB breaks the interleaved
+    schedule's consume-next-tick invariant, so arrivals buffer in a
+    per-(m, v) stash instead of one carried slot."""
+
+    k_stages: int
+    microbatches: int
+    virtual_stages: int
+    num_ticks: int
+    kind: np.ndarray          # [T, K] int32
+    micro_index: np.ndarray   # [T, K] int32, clipped
+    chunk_index: np.ndarray   # [T, K] int32, clipped
+    fwd_in_valid: np.ndarray  # [T, K] bool
+    fwd_in_micro: np.ndarray  # [T, K] int32
+    fwd_in_chunk: np.ndarray  # [T, K] int32
+    bwd_in_valid: np.ndarray  # [T, K] bool
+    bwd_in_micro: np.ndarray  # [T, K] int32
+    bwd_in_chunk: np.ndarray  # [T, K] int32
+
+    @property
+    def counts(self) -> dict:
+        kinds = self.kind
+        return {"f": int((kinds == ZB_F).sum()),
+                "b": int((kinds == ZB_B).sum()),
+                "w": int((kinds == ZB_W).sum()),
+                "bubble": int((kinds == ZB_NONE).sum())}
+
+    @property
+    def useful_tick_fraction(self) -> float:
+        """Fraction of (tick, stage) cells doing real work (F, B or W
+        — equal-cost tick convention, the table's cost model). The
+        interleaved baseline at the same (K, M, V) is M*V/(M*V+K-1):
+        its forward scan's fraction, which reverse-mode AD's mirrored
+        backward preserves. ZB's W deferral fills the cooldown, so this
+        is strictly higher (pinned by tests/test_pp_zb.py)."""
+        return 1.0 - self.counts["bubble"] / (self.num_ticks * self.k_stages)
+
+
+def schedule_useful_fraction(name: str, k: int, m: int, v: int = 1) -> float:
+    """Analytic useful-tick fraction for one named schedule — the
+    number bench.py records (no chip required)."""
+    name = normalize_pp_schedule(name, v)
+    if name == "zb":
+        return build_zb_schedule(k, m, v).useful_tick_fraction
+    vv = 1 if name == "gpipe" else max(1, int(v))
+    return m * vv / (m * vv + k - 1)
+
+
+def build_zb_schedule(k_stages: int, microbatches: int,
+                      virtual_stages: int = 1) -> ZBSchedule:
+    """Build the zero-bubble F/B/W tick table (module docstring): a
+    deterministic greedy list scheduler over the dependency graph.
+
+    Dependencies (arrival = producer tick + 1, the ring hop):
+    - F(m, 0) is always ready; F(m, j) needs F(m, j-1)'s arrival.
+    - B(m, KV-1) needs F(m, KV-2)'s arrival (it linearizes the loss
+      head from the stashed input); B(m, j) needs B(m, j+1)'s
+      cotangent arrival AND F(m, j-1)'s activation arrival.
+    - W(m, j) runs after B(m, j) on the same stage (after the
+      cotangent arrival for j=0, which has no B) — deferral is free
+      because nothing downstream consumes a weight grad until the
+      post-scan fold, which is always inside the same optimizer step.
+
+    Greedy priority per stage per tick: B (smallest m, largest j —
+    downstream-first unblocks the ring) > F (smallest m, j) > W.
+    Deterministic, so the compiled step, the printer, and the bench
+    all see the identical table."""
+    k = int(k_stages)
+    m = int(microbatches)
+    v = int(virtual_stages)
+    if k < 2:
+        raise ValueError(f"the zero-bubble schedule needs k_stages >= 2 "
+                         f"(got K={k}); a 1-stage pipeline has no ring "
+                         f"to fill")
+    if m < 1 or v < 1:
+        raise ValueError(f"need microbatches >= 1 and virtual_stages >= 1, "
+                         f"got M={m}, V={v}")
+    if v > 1 and m % k:
+        raise ValueError(
+            f"the interleaved block layout (virtual_stages={v}) processes "
+            f"microbatches in rounds of the stage count: M={m} must be "
+            f"divisible by K={k}")
+    n_groups = k * v
+    stage_of = lambda j: j % k
+    pend: list[set] = [set() for _ in range(k)]
+    for mm in range(m):
+        for j in range(n_groups):
+            s = stage_of(j)
+            if j < n_groups - 1:
+                pend[s].add(("F", mm, j))
+            if j > 0:
+                pend[s].add(("B", mm, j))
+            pend[s].add(("W", mm, j))
+    t_f: dict = {}
+    t_b: dict = {}
+    cells: list[list] = []
+    t = 0
+    max_t = 8 * 3 * m * n_groups + 16  # runaway guard, never hit
+
+    def ready_at(kind, mm, j):
+        if kind == "F":
+            if j == 0:
+                return 0
+            tf = t_f.get((mm, j - 1))
+            return None if tf is None else tf + 1
+        if kind == "B":
+            tf = t_f.get((mm, j - 1))
+            if tf is None:
+                return None
+            if j == n_groups - 1:
+                return tf + 1
+            tb = t_b.get((mm, j + 1))
+            return None if tb is None else max(tb + 1, tf + 1)
+        # W
+        if j == 0:
+            tb = t_b.get((mm, 1))
+            tf = t_f.get((mm, 0))
+            if tb is None or tf is None:
+                return None
+            return max(tb + 1, tf + 1)
+        tb = t_b.get((mm, j))
+        return None if tb is None else tb + 1
+
+    while any(pend) and t < max_t:
+        row = [None] * k
+        for s in range(k):
+            best = None
+            for (kind, mm, j) in pend[s]:
+                r = ready_at(kind, mm, j)
+                if r is None or r > t:
+                    continue
+                # priority: B first (downstream-first), then F, then W
+                rank = {"B": (0, mm, -j), "F": (1, mm, j),
+                        "W": (2, mm, j)}[kind]
+                if best is None or rank < best[0]:
+                    best = (rank, kind, mm, j)
+            if best is not None:
+                _, kind, mm, j = best
+                row[s] = (kind, mm, j)
+                pend[s].discard((kind, mm, j))
+                if kind == "F":
+                    t_f[(mm, j)] = t
+                elif kind == "B":
+                    t_b[(mm, j)] = t
+        cells.append(row)
+        t += 1
+    if any(pend):
+        raise RuntimeError(f"zb scheduler failed to place all units for "
+                           f"K={k}, M={m}, V={v} within {max_t} ticks")
+    num_ticks = t
+    kind_tbl = np.zeros((num_ticks, k), np.int32)
+    mb_tbl = np.zeros((num_ticks, k), np.int32)
+    ch_tbl = np.zeros((num_ticks, k), np.int32)
+    fiv = np.zeros((num_ticks, k), bool)
+    fim = np.zeros((num_ticks, k), np.int32)
+    fic = np.zeros((num_ticks, k), np.int32)
+    biv = np.zeros((num_ticks, k), bool)
+    bim = np.zeros((num_ticks, k), np.int32)
+    bic = np.zeros((num_ticks, k), np.int32)
+    code = {"F": ZB_F, "B": ZB_B, "W": ZB_W}
+    for tt, row in enumerate(cells):
+        for s, cell in enumerate(row):
+            if cell is None:
+                continue
+            kind, mm, j = cell
+            kind_tbl[tt, s] = code[kind]
+            mb_tbl[tt, s] = mm
+            ch_tbl[tt, s] = j // k
+            if kind == "F":
+                # every scheduled F feeds unit j+1 (the last group has
+                # no F tick), arriving next tick on the next neighbor
+                fiv[tt + 1, (s + 1) % k] = True
+                fim[tt + 1, (s + 1) % k] = mm
+                fic[tt + 1, (s + 1) % k] = (j + 1) // k
+            elif kind == "B":
+                # the cotangent for unit j-1, arriving next tick on the
+                # previous neighbor (j >= 1 always for a B cell)
+                biv[tt + 1, (s - 1) % k] = True
+                bim[tt + 1, (s - 1) % k] = mm
+                bic[tt + 1, (s - 1) % k] = (j - 1) // k
+    return ZBSchedule(
+        k_stages=k, microbatches=m, virtual_stages=v, num_ticks=num_ticks,
+        kind=kind_tbl, micro_index=mb_tbl, chunk_index=ch_tbl,
+        fwd_in_valid=fiv, fwd_in_micro=fim, fwd_in_chunk=fic,
+        bwd_in_valid=biv, bwd_in_micro=bim, bwd_in_chunk=bic,
+    )
+
+
+def format_zb_schedule(sched: ZBSchedule) -> str:
+    """Human-readable F/B/W tick table (``tools/trace_ops.py --schedule
+    K M [V] zb``): cells ``F m0.v0`` / ``B m0.v0`` / ``W m0.v0`` or
+    ``--`` for bubble cells — B and W ticks distinguished so the
+    cooldown visibly fills with deferred weight grads."""
+    k, m, v = sched.k_stages, sched.microbatches, sched.virtual_stages
+    c = sched.counts
+    inter = m * v / (m * v + k - 1)
+    lines = [
+        f"pipeline schedule: K={k} stages, M={m} microbatches, "
+        f"V={v} virtual stage group(s) per device (zero-bubble)",
+        f"ticks per step: {sched.num_ticks} "
+        f"(F {c['f']}, B {c['b']}, W {c['w']}, bubble {c['bubble']} "
+        f"cells over {k} stages)",
+        f"useful-tick fraction: {sched.useful_tick_fraction:.4f}  "
+        f"[interleaved baseline at the same (K, M, V): {inter:.4f}]",
+        "",
+        "tick | " + " | ".join(f"stage {s}" for s in range(k)),
+    ]
+    lines.append("-----+-" + "-+-".join("-" * 8 for _ in range(k)))
+    sym = {ZB_F: "F", ZB_B: "B", ZB_W: "W"}
+    for t in range(sched.num_ticks):
+        out = []
+        for s in range(k):
+            kd = int(sched.kind[t, s])
+            if kd == ZB_NONE:
+                out.append("--".ljust(8))
+            else:
+                out.append(f"{sym[kd]} m{sched.micro_index[t, s]}."
+                           f"v{sched.chunk_index[t, s]}".ljust(8))
+        lines.append(f"{t:4d} | " + " | ".join(out))
+    return "\n".join(lines)
 
 
 def format_schedule(sched: PPSchedule) -> str:

@@ -122,6 +122,99 @@ def _scatter_leaf(g):
                             tiled=True)
 
 
+DEFAULT_BUCKET_MB = 4.0  # --zero_bucket_mb default (the comm/latency knob)
+
+
+def _bucket_plan(leaves, d: int, bucket_bytes: int) -> list[list[int]]:
+    """Host-side static bucketing: consecutive leaves (canonical
+    flatten order) grouped while the PADDED payload stays within
+    ``bucket_bytes`` (every bucket holds >= 1 leaf; a dtype change
+    starts a new bucket — buckets concatenate). Static so the compiled
+    program's collective count is fixed."""
+    d = max(1, int(d))
+    bucket_bytes = max(1, int(bucket_bytes))
+    plan: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    cur_dtype = None
+    for i, leaf in enumerate(leaves):
+        n = _leaf_size(leaf)
+        padded = (-(-n // d)) * d * np.dtype(leaf.dtype).itemsize
+        if cur and (leaf.dtype != cur_dtype
+                    or cur_bytes + padded > bucket_bytes):
+            plan.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += padded
+        cur_dtype = leaf.dtype
+    if cur:
+        plan.append(cur)
+    return plan
+
+
+def n_buckets(model, d: int, bucket_mb: float) -> int:
+    """Static bucket count for a model's param/grad tree at one bucket
+    size — the analytic fact the comm ledger and bench record."""
+    meta = jax.tree.leaves(abstract_params(model))
+    return len(_bucket_plan(meta, d, int(bucket_mb * 2 ** 20)))
+
+
+def _scatter_bucketed(grads, d: int, bucket_bytes: int):
+    """Bucketed reduce-scatter: leaves pad and reshape to [D, c] (row r
+    IS rank r's chunk — identical ownership to the per-leaf
+    ``_scatter_leaf``), concatenate along the chunk axis per bucket,
+    one ``psum_scatter`` per bucket, split back. Elementwise the same
+    sums over the same ranks as the per-leaf scatters, so the chunks
+    are BITWISE equal (pinned by tests/test_zero.py) — what changes is
+    the collective count: ceil(|G|/bucket) right-sized ops that XLA's
+    async scheduler can issue as backward produces their operands,
+    instead of leaf-granular ops or one serial flat scatter."""
+    leaves, treedef = jax.tree.flatten(grads)
+    plan = _bucket_plan(leaves, d, bucket_bytes)
+    out = [None] * len(leaves)
+    for bucket in plan:
+        mats = []
+        for i in bucket:
+            flat = leaves[i].reshape(-1)
+            pad = (-flat.size) % d
+            if pad:
+                flat = jnp.pad(flat, (0, pad))
+            mats.append(flat.reshape(d, -1))
+        buck = mats[0] if len(mats) == 1 else jnp.concatenate(mats, axis=1)
+        red = lax.psum_scatter(buck, DATA_AXIS, scatter_dimension=0,
+                               tiled=True).reshape(-1)
+        off = 0
+        for i, mat in zip(bucket, mats):
+            c = mat.shape[1]
+            out[i] = red[off:off + c]
+            off += c
+    return jax.tree.unflatten(treedef, out)
+
+
+def _gather_bucketed(chunks, meta, d: int, bucket_bytes: int):
+    """Bucketed all-gather: per-leaf 1/D chunks concatenate per bucket,
+    one tiled ``all_gather`` per bucket, then per-leaf chunks slice
+    back out of the [D, C] view and reassemble exactly like
+    ``_gather_leaf`` would — pure data movement, bitwise equal to the
+    per-leaf gathers."""
+    cleaves, treedef = jax.tree.flatten(chunks)
+    mleaves = jax.tree.leaves(meta)
+    plan = _bucket_plan(mleaves, d, bucket_bytes)
+    out = [None] * len(cleaves)
+    for bucket in plan:
+        cat = (cleaves[bucket[0]] if len(bucket) == 1
+               else jnp.concatenate([cleaves[i] for i in bucket]))
+        full = lax.all_gather(cat, DATA_AXIS, tiled=True).reshape(d, -1)
+        off = 0
+        for i in bucket:
+            c = cleaves[i].shape[0]
+            n = _leaf_size(mleaves[i])
+            out[i] = full[:, off:off + c].reshape(-1)[:n].reshape(
+                mleaves[i].shape)
+            off += c
+    return jax.tree.unflatten(treedef, out)
+
+
 def _local_chunk(x):
     """This rank's 1/D flat chunk of a REPLICATED full leaf (the ZeRO-1
     param slice the optimizer updates): pad, then slice at the rank's
@@ -239,19 +332,77 @@ def fetch_state_zero(state: TrainState, model, level: int) -> TrainState:
 
 
 def _zero_step_core(model, optimizer, mesh, level, keep_prob,
-                    grad_transform, accum_steps: int = 1):
+                    grad_transform, accum_steps: int = 1,
+                    overlap: bool = False, bucket_bytes: int | None = None):
     """The per-shard ZeRO step body shared by the host-fed builder and
     the device-resident sampler (``device_step.make_zero_device_train_
-    step``): ``core(state, batch, sub, rng) -> (state, metrics)`` for
-    inside ``shard_map``. The caller owns the rng-split/augment/sample
-    derivations (they must bit-match its replicated twin's); the core
-    owns grads -> reduce-scatter -> clip -> sharded update -> gather."""
+    step``): ``core(state, batch, sub, rng, prefetched=None) ->
+    (state, metrics, next_full)`` for inside ``shard_map``. The caller
+    owns the rng-split/augment/sample derivations (they must bit-match
+    its replicated twin's); the core owns grads -> reduce-scatter ->
+    clip -> sharded update -> gather.
+
+    ``overlap=True`` switches to the comm/compute-overlap collective
+    pattern — BITWISE the same trajectory (tests pin it), different
+    wire schedule:
+
+    - grads reduce-scatter in ``bucket_bytes`` BUCKETS (same padding,
+      same per-leaf chunk ownership as the per-leaf scatters — the
+      [D, c] row layout), so the collectives issue as backward
+      produces their operands instead of leaf-granular or one flat
+      serial scatter at the end;
+    - at level 3 the params materialize from ONE bucketed all_gather
+      reused by forward AND backward (grads are taken w.r.t. the full
+      params and explicitly reduce-scattered — bitwise equal to the
+      serial path's remat'd gather transpose, pinned), cutting the
+      wire from |G|+2|P| to |G|+|P|; after the update the NEXT step's
+      gather issues immediately (``next_full``), so a chunked caller
+      carrying it double-buffers the gather behind the step epilogue
+      and the next step's on-device sampling — the prefetch window.
+      A caller that ignores ``next_full`` (the host-fed one-step
+      wrapper) costs nothing: XLA dead-code-eliminates the unused
+      gather."""
     level = _check_level(level)
     d = mesh.shape[DATA_AXIS]
     meta = abstract_params(model)
+    bucket_bytes = int(bucket_bytes or DEFAULT_BUCKET_MB * 2 ** 20)
 
-    def core(state: TrainState, batch, sub, rng):
-        if level >= 3:
+    def scatter_mean(grads):
+        if overlap:
+            return jax.tree.map(lambda g: g / d,
+                                _scatter_bucketed(grads, d, bucket_bytes))
+        return jax.tree.map(lambda g: _scatter_leaf(g) / d, grads)
+
+    def gather_full(chunks):
+        if overlap:
+            return _gather_bucketed(chunks, meta, d, bucket_bytes)
+        return _gather_params(chunks, meta)
+
+    def core(state: TrainState, batch, sub, rng, prefetched=None):
+        next_full = None
+        if level >= 3 and overlap:
+            full = prefetched if prefetched is not None \
+                else gather_full(state.params)
+            if accum_steps <= 1:
+                def loss_fn(fp):
+                    return loss_and_metrics(
+                        model, fp, batch, keep_prob=keep_prob, rng=sub,
+                        train=True, model_state=state.model_state)
+
+                gfull, aux = jax.grad(loss_fn, has_aux=True)(full)
+                metrics = aux["metrics"]
+                model_state = aux["model_state"]
+            else:
+                gfull, metrics, model_state = compute_grads(
+                    model, full, batch, keep_prob=keep_prob, rng=sub,
+                    model_state=state.model_state,
+                    accum_steps=accum_steps)
+            # explicit bucketed reduce-scatter of the full grad — the
+            # serial path's gather TRANSPOSE computes the same chunks
+            # (pinned bitwise), this one just issues them bucket-wise
+            gchunks = scatter_mean(gfull)
+            pchunks = state.params
+        elif level >= 3:
             if accum_steps <= 1:
                 # grads w.r.t. the CHUNKS through a remat'd gather: the
                 # all_gather transpose IS the reduce-scatter (bitwise
@@ -292,7 +443,7 @@ def _zero_step_core(model, optimizer, mesh, level, keep_prob,
             # reduce-scatter (|G| on the wire) where the replicated step
             # all-reduces (2|G|); /d after, matching pmean's psum-then-
             # divide bit-for-bit
-            gchunks = jax.tree.map(lambda g: _scatter_leaf(g) / d, grads)
+            gchunks = scatter_mean(grads)
             pchunks = jax.tree.map(_local_chunk, state.params)
         if grad_transform is not None:
             gchunks = grad_transform(gchunks)
@@ -307,11 +458,16 @@ def _zero_step_core(model, optimizer, mesh, level, keep_prob,
         pchunks = apply_updates(pchunks, updates)
         if level >= 3:
             params = pchunks  # stays sharded; the next step re-gathers
+            if overlap:
+                # prefetch: issue the NEXT step's gather now — a
+                # chunked caller carries it, hiding the gather behind
+                # the epilogue + the next step's sampling
+                next_full = gather_full(pchunks)
         else:
             # ONE all_gather (|P|) rebuilds the replicated params
-            params = _gather_params(pchunks, meta)
+            params = gather_full(pchunks)
         return TrainState(params, opt_state, state.step + 1, rng,
-                          model_state), metrics
+                          model_state), metrics, next_full
 
     return core
 
@@ -319,7 +475,8 @@ def _zero_step_core(model, optimizer, mesh, level, keep_prob,
 def make_zero_train_step(model, optimizer, mesh, level: int,
                          keep_prob: float = 1.0, donate: bool = True,
                          grad_transform=None, accum_steps: int = 1,
-                         augment_fn=None):
+                         augment_fn=None, overlap: bool = False,
+                         bucket_mb: float = DEFAULT_BUCKET_MB):
     """Compiled ZeRO-sharded sync-DP train step: (ZeRO-layout state,
     sharded batch) -> (state, metrics). Drop-in for
     ``make_dp_train_step`` on a state placed by ``shard_state_zero``;
@@ -327,9 +484,12 @@ def make_zero_train_step(model, optimizer, mesh, level: int,
     same augment stream, same elementwise update arithmetic — only the
     collective pattern changes). ``grad_transform`` runs on the
     SCATTERED mean-grad chunks — pass ``zero_clip_transform`` for an
-    axis-correct ``--clip_norm``."""
+    axis-correct ``--clip_norm``. ``overlap``/``bucket_mb`` switch to
+    the bucketed/prefetched collective pattern (``--zero_overlap``;
+    still bit-identical — see ``_zero_step_core``)."""
     core = _zero_step_core(model, optimizer, mesh, level, keep_prob,
-                           grad_transform, accum_steps)
+                           grad_transform, accum_steps, overlap=overlap,
+                           bucket_bytes=int(bucket_mb * 2 ** 20))
 
     def per_shard(state: TrainState, batch):
         rng, sub = jax.random.split(state.rng)
@@ -337,7 +497,8 @@ def make_zero_train_step(model, optimizer, mesh, level: int,
         sub = jax.random.fold_in(sub, lax.axis_index(DATA_AXIS))
         batch = apply_augment(augment_fn, batch, state.rng,
                               shard_index=lax.axis_index(DATA_AXIS))
-        return core(state, batch, sub, rng)
+        state, metrics, _ = core(state, batch, sub, rng)
+        return state, metrics
 
     batch_spec = (P(DATA_AXIS), P(DATA_AXIS))
     cache: dict = {}
@@ -460,40 +621,85 @@ def zero_memory_budget(model, optimizer, d: int) -> dict:
 
 
 def zero_comm_rows(grad_bytes: int, param_bytes: int, level: int,
-                   d: int) -> list[dict]:
+                   d: int, overlap: bool = False,
+                   bucket_mb: float = DEFAULT_BUCKET_MB) -> list[dict]:
     """Static per-step collective wire bytes for this module's data-axis
     patterns — the comm ledger's ZeRO/DP rows (utils/resources.
     comm_ledger composes them; the formula lives next to the
     collectives it prices). Conventions per the module docstring:
     all-reduce ~2|G|, reduce-scatter |G|, all-gather |P|. ``level=0``
     is plain replicated DP's grad all-reduce. A 1-way data axis moves
-    nothing."""
+    nothing.
+
+    Each row carries ``exposed_bytes`` — the analytic share that sits
+    on the step's critical path. Serial rows expose everything.
+    ``overlap=True`` prices the ``--zero_overlap`` pattern: a bucketed
+    reduce-scatter exposes only its LAST bucket (earlier buckets issue
+    while backward still produces later grads), a prefetched level-3
+    gather exposes nothing (it issued right after the previous update,
+    hidden behind the epilogue + next-step sampling), and the level-3
+    backward re-gather row DISAPPEARS — the prefetched full params are
+    reused, cutting the wire from |G|+2|P| to |G|+|P|."""
     if d < 2:
         return []
     if level == 0:
         return [{"collective": "all_reduce(grads)", "axis": "data",
-                 "bytes": 2 * grad_bytes,
+                 "bytes": 2 * grad_bytes, "exposed_bytes": 2 * grad_bytes,
                  "note": "replicated DP: ring all-reduce moves ~2|G|"}]
     _check_level(level)
+    bucket_bytes = max(1, int(bucket_mb * 2 ** 20))
+    scatter_exposed = (min(bucket_bytes, grad_bytes) if overlap
+                      else grad_bytes)
+    scatter_note = (
+        f"bucketed reduce-scatter ({-(-grad_bytes // bucket_bytes)} "
+        f"bucket(s) of <= {bucket_mb:g} MB): buckets issue as backward "
+        f"produces leaves; only the last is exposed" if overlap else
+        "reduce-scatter: each rank receives its 1/D chunk of the "
+        "summed gradient (|G| on the wire)")
     rows = [{"collective": "psum_scatter(grads)", "axis": "data",
-             "bytes": grad_bytes,
-             "note": "reduce-scatter: each rank receives its 1/D chunk "
-                     "of the summed gradient (|G| on the wire)"}]
+             "bytes": grad_bytes, "exposed_bytes": scatter_exposed,
+             "note": scatter_note}]
     if level == 1:
-        rows.append({"collective": "all_gather(params)", "axis": "data",
-                     "bytes": param_bytes,
-                     "note": "one gather rebuilds the replicated "
-                             "updated params (|P|)"})
+        rows.append({
+            "collective": "all_gather(params)", "axis": "data",
+            "bytes": param_bytes,
+            "exposed_bytes": (min(bucket_bytes, param_bytes) if overlap
+                              else param_bytes),
+            "note": ("bucketed gather rebuilds the replicated params; "
+                     "the next step's sampling hides all but the last "
+                     "bucket" if overlap else
+                     "one gather rebuilds the replicated updated "
+                     "params (|P|)")})
+    elif overlap:  # level 3 overlapped: ONE prefetched gather, reused
+        rows[0]["collective"] = "psum_scatter(grads, bucketed)"
+        rows.append({
+            "collective": "all_gather(params, prefetched)",
+            "axis": "data", "bytes": param_bytes, "exposed_bytes": 0,
+            "note": "issued right after the previous update and reused "
+                    "by forward AND backward — the remat re-gather's "
+                    "|P| never hits the wire"})
     else:  # level 3: params live sharded, re-gathered fwd + bwd (remat)
         rows[0]["collective"] = "reduce_scatter(grad transpose)"
         rows[0]["note"] = ("the all_gather's transpose routes grad "
                            "contributions to the owning rank (|G|)")
         rows.append({"collective": "all_gather(params, forward)",
                      "axis": "data", "bytes": param_bytes,
+                     "exposed_bytes": param_bytes,
                      "note": "sharded params materialize for the "
                              "forward (|P|)"})
         rows.append({"collective": "all_gather(params, backward remat)",
                      "axis": "data", "bytes": param_bytes,
+                     "exposed_bytes": param_bytes,
                      "note": "jax.checkpoint re-gathers instead of "
                              "keeping a full copy (|P|)"})
     return rows
+
+
+def zero_exposed_comm_bytes(grad_bytes: int, param_bytes: int, level: int,
+                            d: int, overlap: bool = False,
+                            bucket_mb: float = DEFAULT_BUCKET_MB) -> int:
+    """Analytic critical-path wire bytes per step — the bench's
+    ``zero_exposed_comm_bytes`` fact (sum of the rows' exposure)."""
+    return int(sum(r["exposed_bytes"]
+                   for r in zero_comm_rows(grad_bytes, param_bytes, level,
+                                           d, overlap, bucket_mb)))

@@ -150,7 +150,8 @@ def make_zero_device_train_step(model, optimizer, mesh, level: int,
                                 batch_size: int, *,
                                 keep_prob: float = 1.0, chunk: int = 1,
                                 donate: bool = True, grad_transform=None,
-                                augment_fn=None):
+                                augment_fn=None, overlap: bool = False,
+                                bucket_mb: float | None = None):
     """ZeRO-sharded chunked step over device-resident data — the
     ``--zero`` composition of the headline input path. Sampling is the
     DP device step's verbatim (same salted PRNG folds, replicated
@@ -160,9 +161,21 @@ def make_zero_device_train_step(model, optimizer, mesh, level: int,
     reduce-scatter over the data axis, the optimizer updates each
     rank's 1/D state shard, and — at level 1 — one all_gather rebuilds
     the replicated params. ``grad_transform`` arrives already
-    axis-aware (``zero_clip_transform``)."""
+    axis-aware (``zero_clip_transform``).
+
+    ``overlap=True`` (``--zero_overlap``) buckets the collectives and —
+    at level 3 — DOUBLE-BUFFERS the param gather inside the scan body:
+    each step ends by issuing the next step's all_gather, the scan
+    carries the gathered full params, and the next iteration consumes
+    them directly — XLA's async collectives hide the gather behind the
+    step epilogue and the next step's on-device sampling. One warmup
+    gather per dispatch primes the carry. Trajectories stay BITWISE
+    identical to the serial ZeRO path (tests pin it)."""
     from distributed_tensorflow_tpu.parallel.zero import (
+        DEFAULT_BUCKET_MB,
+        _gather_bucketed,
         _zero_step_core,
+        abstract_params,
         zero_state_specs,
     )
 
@@ -172,15 +185,41 @@ def make_zero_device_train_step(model, optimizer, mesh, level: int,
             f"batch_size={batch_size} not divisible by the {n_data}-way "
             f"data axis")
     local_batch = batch_size // n_data
+    bucket_mb = DEFAULT_BUCKET_MB if bucket_mb is None else float(bucket_mb)
     core = _zero_step_core(model, optimizer, mesh, level, keep_prob,
-                           grad_transform)
+                           grad_transform, overlap=overlap,
+                           bucket_bytes=int(bucket_mb * 2 ** 20))
 
-    def body(state: TrainState, data):
-        # _split_and_sample IS _sampled_step_body's sampler: every shard
-        # draws the same rows a replicated-DP run would
-        rng, sub, batch = _split_and_sample(state, data, local_batch,
-                                            DATA_AXIS, augment_fn)
-        return core(state, batch, sub, rng)
+    if overlap and level >= 3:
+        meta = abstract_params(model)
+        bucket_bytes = int(bucket_mb * 2 ** 20)
+
+        def chunk_fn(state: TrainState, data):
+            # warmup gather primes the double buffer once per dispatch
+            full0 = _gather_bucketed(state.params, meta, n_data,
+                                     bucket_bytes)
+
+            def body(carry, _):
+                st, full = carry
+                rng, sub, batch = _split_and_sample(
+                    st, data, local_batch, DATA_AXIS, augment_fn)
+                st, metrics, nxt = core(st, batch, sub, rng,
+                                        prefetched=full)
+                return (st, nxt), metrics
+
+            (state, _), metrics = lax.scan(body, (state, full0), None,
+                                           length=chunk)
+            return state, jax.tree.map(lambda mm: mm[-1], metrics)
+    else:
+        def body(state: TrainState, data):
+            # _split_and_sample IS _sampled_step_body's sampler: every
+            # shard draws the same rows a replicated-DP run would
+            rng, sub, batch = _split_and_sample(state, data, local_batch,
+                                                DATA_AXIS, augment_fn)
+            st, metrics, _ = core(state, batch, sub, rng)
+            return st, metrics
+
+        chunk_fn = _scan_chunk(body, chunk)
 
     cache: dict = {}
 
@@ -189,7 +228,7 @@ def make_zero_device_train_step(model, optimizer, mesh, level: int,
         if fn is None:
             specs = zero_state_specs(state, level)
             sharded = jax.shard_map(
-                _scan_chunk(body, chunk), mesh=mesh,
+                chunk_fn, mesh=mesh,
                 in_specs=(specs, P()),
                 out_specs=(specs, P()),
                 check_vma=False)
@@ -325,7 +364,8 @@ def make_pp_device_train_step(model, optimizer, mesh, batch_size: int,
                               microbatches: int, *, keep_prob: float = 1.0,
                               chunk: int = 1, donate: bool = True,
                               grad_transform=None,
-                              virtual_stages: int = 1):
+                              virtual_stages: int = 1,
+                              schedule: str = "auto"):
     """Pipeline-parallel chunked step over device-resident data — the
     GPipe schedule composed with the zero-host-bytes input path. The
     split lives DATA-SHARDED in HBM (``put_device_data(...,
@@ -341,7 +381,9 @@ def make_pp_device_train_step(model, optimizer, mesh, batch_size: int,
     step — pass ``pp_clip_transform`` for an axis-correct --clip_norm.
     ``virtual_stages=V`` selects the interleaved schedule (state stacked
     by ``shard_state_pp(..., virtual_stages=V)``; bit-identical
-    trajectories to V=1 with a ~V-fold smaller pipeline bubble)."""
+    trajectories to V=1 with a ~V-fold smaller pipeline bubble).
+    ``schedule="zb"`` runs the zero-bubble F/B/W table on the same
+    layout — still bit-identical (parallel/pipeline_parallel)."""
     from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
         _pp_step_fn,
         pp_state_specs,
@@ -358,7 +400,7 @@ def make_pp_device_train_step(model, optimizer, mesh, batch_size: int,
             f"per-shard batch {local_batch} must split into "
             f"{microbatches} microbatches")
     pp_step = _pp_step_fn(model, optimizer, mesh, microbatches, keep_prob,
-                          grad_transform, virtual_stages)
+                          grad_transform, virtual_stages, schedule)
     return _make_resident_sharded_step(pp_step, pp_state_specs, mesh,
                                        local_batch, chunk, donate)
 

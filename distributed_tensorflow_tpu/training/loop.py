@@ -1258,7 +1258,10 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
         stage_batch_pp,
     )
     from distributed_tensorflow_tpu.parallel.pp_schedule import (
+        build_zb_schedule,
+        normalize_pp_schedule,
         validate_pp_layout,
+        validate_zb_layout,
     )
 
     if ds.meta.get("kind") != "lm":
@@ -1283,10 +1286,23 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
 
     vstages = max(1, int(getattr(FLAGS, "virtual_stages", 1)))
     micro = int(getattr(FLAGS, "pp_microbatches", 0)) or model_axis
+    sched_name = normalize_pp_schedule(
+        getattr(FLAGS, "pp_schedule", "auto"), vstages)
     # layout constraints up front (clear errors instead of mid-trace):
-    # K*V must divide the blocks, and V>1 schedules microbatch rounds of K
+    # K*V must divide the blocks, V>1 schedules microbatch rounds of K,
+    # and zb additionally needs >= 2 blocks per virtual-stage group
     validate_pp_layout(model.num_blocks, model_axis, vstages,
                        microbatches=micro)
+    if sched_name == "zb":
+        validate_zb_layout(model.num_blocks, model_axis, vstages,
+                           microbatches=micro)
+        zs = build_zb_schedule(model_axis, micro, vstages)
+        # the schedule's cost facts land in the span stream once, so
+        # trace_view/fleet timelines show WHICH table the run compiled
+        telemetry.get_tracer().record_instant(
+            "zb_schedule", k_stages=model_axis, microbatches=micro,
+            virtual_stages=vstages, ticks=zs.num_ticks, **zs.counts,
+            useful_tick_fraction=round(zs.useful_tick_fraction, 4))
     clip = (pp_clip_transform(FLAGS.clip_norm, virtual_stages=vstages)
             if getattr(FLAGS, "clip_norm", 0.0) > 0 else None)
     mesh = make_mesh(MeshSpec(data=-1, model=model_axis))
@@ -1302,12 +1318,14 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
 
     if getattr(FLAGS, "device_data", False):
         return _train_pipeline_device(FLAGS, ds, model, opt, state, mesh,
-                                      n_chips, micro, clip, vstages)
+                                      n_chips, micro, clip, vstages,
+                                      sched_name)
 
     step_fn = make_pp_train_step(model, opt, mesh, micro,
                                  keep_prob=FLAGS.keep_prob,
                                  grad_transform=clip,
-                                 virtual_stages=vstages)
+                                 virtual_stages=vstages,
+                                 schedule=sched_name)
     sv = Supervisor(
         is_chief=(FLAGS.task_index == 0),
         logdir=FLAGS.logdir,
@@ -1346,8 +1364,14 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
             if rmon is not None:
                 rmon.note_dispatch("pp_step", staged)
             t0 = time.perf_counter()
-            with trace_span("pp_step", step=step), \
-                    telemetry.armed("pp_step", step=step):
+            # the zb schedule gets its own span name so the PR-6
+            # timeline distinguishes B/W-split steps from AD-backward
+            # ones (the zb_schedule instant carries the tick counts)
+            span_name = ("pp_step_zb" if sched_name == "zb"
+                         else "pp_step")
+            with trace_span(span_name, step=step,
+                            schedule=sched_name), \
+                    telemetry.armed(span_name, step=step):
                 pp_state, m = step_fn(pp_state, staged)
             stimer.add("dispatch", time.perf_counter() - t0)
             step += 1
@@ -1414,7 +1438,8 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
 
 
 def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
-                           micro, clip, vstages: int = 1) -> TrainResult:
+                           micro, clip, vstages: int = 1,
+                           sched_name: str = "auto") -> TrainResult:
     """--pipeline --device_data: the GPipe stage ring over a DEVICE-
     RESIDENT split. The split stages data-sharded into HBM once
     (``put_device_data(..., data_sharded=True)``); every step samples
@@ -1456,7 +1481,8 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
             fn = chunk_fns[length] = make_pp_device_train_step(
                 model, opt, mesh, FLAGS.batch_size, micro,
                 keep_prob=FLAGS.keep_prob, chunk=length,
-                grad_transform=clip, virtual_stages=vstages)
+                grad_transform=clip, virtual_stages=vstages,
+                schedule=sched_name)
         return fn(pp_state, data)
 
     sv = Supervisor(
@@ -1502,8 +1528,11 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                 # specializes on (run_chunk caches one fn per length)
                 rmon.note_dispatch("pp_chunk", signature=(length,))
             t0 = time.perf_counter()
-            with trace_span("pp_chunk", step=step, length=length), \
-                    telemetry.armed("pp_chunk", step=step, length=length):
+            chunk_span = ("pp_chunk_zb" if sched_name == "zb"
+                          else "pp_chunk")
+            with trace_span(chunk_span, step=step, length=length,
+                            schedule=sched_name), \
+                    telemetry.armed(chunk_span, step=step, length=length):
                 pp_state, m = run_chunk(pp_state, length)
             stimer.add("dispatch", time.perf_counter() - t0)
             step += length
@@ -1647,15 +1676,38 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
             f"examples) must split into {accum} equal microbatches")
     clip = (zero_clip_transform(FLAGS.clip_norm)
             if getattr(FLAGS, "clip_norm", 0.0) > 0 else None)
+    overlap = bool(getattr(FLAGS, "zero_overlap", False))
+    bucket_mb = float(getattr(FLAGS, "zero_bucket_mb", 4.0) or 4.0)
+    if overlap:
+        # the overlap pattern's analytic facts land in the span stream
+        # once (the prefetched gather + bucketed scatter are inside the
+        # compiled step — this instant is their host-visible footprint)
+        from distributed_tensorflow_tpu.parallel.zero import (
+            n_buckets,
+            zero_exposed_comm_bytes,
+            zero_memory_budget,
+        )
+
+        # one consistent axis width for every fact in the instant (a
+        # 1-chip run prices the 2-way fallback config like the bench)
+        d_eff = max(2, n_chips)
+        g = zero_memory_budget(model, opt, d_eff)["param_bytes"]
+        telemetry.get_tracer().record_instant(
+            "zero_overlap", level=level, bucket_mb=bucket_mb,
+            buckets=n_buckets(model, d_eff, bucket_mb),
+            exposed_bytes=zero_exposed_comm_bytes(
+                g, g, level, d_eff, True, bucket_mb))
 
     if getattr(FLAGS, "device_data", False):
         return _train_zero_device(FLAGS, ds, model, opt, state, mesh,
-                                  n_chips, level, clip, augment_fn)
+                                  n_chips, level, clip, augment_fn,
+                                  overlap, bucket_mb)
 
     step_fn = make_zero_train_step(model, opt, mesh, level,
                                    keep_prob=FLAGS.keep_prob,
                                    grad_transform=clip, accum_steps=accum,
-                                   augment_fn=augment_fn)
+                                   augment_fn=augment_fn,
+                                   overlap=overlap, bucket_mb=bucket_mb)
     eval_fn = make_zero_eval_step(model, mesh, level)
     sv = Supervisor(
         is_chief=(FLAGS.task_index == 0),
@@ -1733,8 +1785,11 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
                 if rmon is not None:
                     rmon.note_dispatch("zero_step", batch)
                 t0 = time.perf_counter()
-                with trace_span("zero_step", step=step), \
-                        telemetry.armed("zero_step", step=step):
+                # own span name under --zero_overlap so the timeline
+                # separates the bucketed/prefetched collective pattern
+                zspan = "zero_step_overlap" if overlap else "zero_step"
+                with trace_span(zspan, step=step), \
+                        telemetry.armed(zspan, step=step):
                     z_state, step_m = step_fn(z_state, batch)
                 stimer.add("dispatch", time.perf_counter() - t0)
                 step += 1
@@ -1798,7 +1853,8 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
 
 
 def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
-                       level, clip, augment_fn) -> TrainResult:
+                       level, clip, augment_fn, overlap: bool = False,
+                       bucket_mb: float = 4.0) -> TrainResult:
     """--zero --device_data: the ZeRO-sharded update over a DEVICE-
     RESIDENT split. The split stages replicated into HBM exactly like
     the plain DP device loop (every rank samples its own rows with the
@@ -1838,7 +1894,8 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
             fn = chunk_fns[length] = make_zero_device_train_step(
                 model, opt, mesh, level, FLAGS.batch_size,
                 keep_prob=FLAGS.keep_prob, chunk=length,
-                grad_transform=clip, augment_fn=augment_fn)
+                grad_transform=clip, augment_fn=augment_fn,
+                overlap=overlap, bucket_mb=bucket_mb)
         return fn(z_state, data)
 
     sv = Supervisor(
@@ -1914,8 +1971,12 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
             if rmon is not None:
                 rmon.note_dispatch("zero_chunk", signature=(length,))
             t0 = time.perf_counter()
-            with trace_span("zero_chunk", step=step, length=length), \
-                    telemetry.armed("zero_chunk", step=step, length=length):
+            # the overlap pattern's chunks get their own span name (the
+            # level-3 warmup gather + double-buffered prefetch live
+            # inside this dispatch)
+            zspan = "zero_chunk_overlap" if overlap else "zero_chunk"
+            with trace_span(zspan, step=step, length=length), \
+                    telemetry.armed(zspan, step=step, length=length):
                 z_state, train_m = run_chunk(z_state, length)
             stimer.add("dispatch", time.perf_counter() - t0)
             step += length

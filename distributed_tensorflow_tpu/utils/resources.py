@@ -346,7 +346,9 @@ def resource_budget(model, optimizer=None, batch_size: int = 1, *,
                     mode: str = "dp", data_ways: int = 1,
                     model_axis: int = 1, zero_level: int = 0,
                     virtual_stages: int = 1,
-                    microbatches: int = 0) -> dict:
+                    microbatches: int = 0, pp_schedule: str = "auto",
+                    zero_overlap: bool = False,
+                    zero_bucket_mb: float = 4.0) -> dict:
     """STATIC per-chip memory budget for ``model`` under one parallel
     layout — ``zero_memory_budget`` generalized across the mode matrix
     (``jax.eval_shape``, no chip, no compute): per-leaf param/opt bytes
@@ -438,14 +440,20 @@ def resource_budget(model, optimizer=None, batch_size: int = 1, *,
 def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
                 mode: str = "dp", data_ways: int = 1, model_axis: int = 1,
                 zero_level: int = 0, virtual_stages: int = 1,
-                microbatches: int = 0) -> dict:
+                microbatches: int = 0, pp_schedule: str = "auto",
+                zero_overlap: bool = False,
+                zero_bucket_mb: float = 4.0) -> dict:
     """STATIC per-step analytic of collective wire bytes for one
     parallel layout, composed from the parallel modules' own row
     builders (the formula lives next to the collective it prices).
     Conventions match the existing docs: all-reduce moves ~2|G|,
     reduce-scatter |G|, all-gather |P|; activation payloads are f32.
-    Returns {mode, rows: [{collective, axis, bytes, note}],
-    comm_bytes_per_step}."""
+    Rows carry ``exposed_bytes`` — the analytic critical-path share:
+    ``zero_overlap``/``zero_bucket_mb`` price the ``--zero_overlap``
+    bucketed/prefetched pattern, ``pp_schedule`` the tick table (zb's
+    cotangent hops overlap the deferred-W slack). Returns {mode,
+    rows: [{collective, axis, bytes, exposed_bytes, note}],
+    comm_bytes_per_step, comm_exposed_bytes_per_step}."""
     import math
 
     import jax
@@ -466,7 +474,8 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
 
     if mode in ("zero1", "zero3"):
         rows += zero_comm_rows(grad_bytes, param_bytes, zero_level,
-                               data_ways)
+                               data_ways, overlap=bool(zero_overlap),
+                               bucket_mb=float(zero_bucket_mb or 4.0))
     elif data_ways > 1:
         # every other multi-chip mode pays the plain DP grad all-reduce
         # over its data rows
@@ -484,7 +493,8 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
         per_shard = -(-int(batch_size) // data_ways)
         act = -(-per_shard // micro) * seq * d_model * F32_BYTES
         rows += pp_comm_rows(act, model_axis, micro,
-                             virtual_stages=max(1, int(virtual_stages)))
+                             virtual_stages=max(1, int(virtual_stages)),
+                             schedule=pp_schedule)
     elif mode == "tp" and model_axis > 1:
         from distributed_tensorflow_tpu.parallel.tensor_parallel import (
             tp_comm_rows,
@@ -521,6 +531,10 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
         "mode": mode, "data_ways": data_ways, "model_axis": model_axis,
         "rows": rows,
         "comm_bytes_per_step": int(sum(r["bytes"] for r in rows)),
+        # rows without an exposure column (TP/EP/SP activation psums)
+        # price as fully exposed — the conservative default
+        "comm_exposed_bytes_per_step": int(sum(
+            r.get("exposed_bytes", r["bytes"]) for r in rows)),
     }
 
 
@@ -857,6 +871,9 @@ class ResourceMonitor:
         if self.ledger is not None:
             out["comm_bytes_per_step"] = float(
                 self.ledger["comm_bytes_per_step"])
+            out["comm_exposed_bytes_per_step"] = float(
+                self.ledger.get("comm_exposed_bytes_per_step",
+                                self.ledger["comm_bytes_per_step"]))
         return out
 
     def note_dispatch(self, site: str, batch=None, signature=None) -> None:
@@ -891,6 +908,10 @@ def parallel_config_from_flags(FLAGS, n_chips: int) -> dict:
         "virtual_stages": max(1, int(getattr(FLAGS, "virtual_stages", 1)
                                      or 1)),
         "microbatches": int(getattr(FLAGS, "pp_microbatches", 0) or 0),
+        "pp_schedule": getattr(FLAGS, "pp_schedule", "auto") or "auto",
+        "zero_overlap": bool(getattr(FLAGS, "zero_overlap", False)),
+        "zero_bucket_mb": float(getattr(FLAGS, "zero_bucket_mb", 4.0)
+                                or 4.0),
     }
 
 

@@ -398,5 +398,71 @@ def test_degraded_record_keeps_zero_facts_non_null():
     assert rec["dp_ab_images_per_sec_per_chip"] is None
     for k in _ZERO_ANALYTIC_KEYS:
         assert rec[k] is not None, k
+    # r14: the overlap phase's analytic facts ride the same record —
+    # measured A/B rates null, schedule fractions/exposure non-null
+    for key in bench._OVERLAP_RATE_KEYS:
+        assert rec[key] is None, key
+    for k in _OVERLAP_ANALYTIC_KEYS:
+        assert rec[k] is not None, k
+    assert rec["pp_zb_useful_tick_fraction"] > \
+        rec["pp_interleaved_useful_tick_fraction"]
     assert rec["zero_live_bytes_source"] == "analytic"
     assert rec["zero_data_ways"] == 2
+
+
+# ---- r14: the overlap phase (pipeline-schedule A/B + ZeRO comm
+# overlap; the analytic fractions/exposure must survive outages) ----
+
+
+_OVERLAP_ANALYTIC_KEYS = (
+    "pp_gpipe_useful_tick_fraction",
+    "pp_interleaved_useful_tick_fraction",
+    "pp_zb_useful_tick_fraction", "pp_zb_ticks",
+    "zero_overlap_bucket_mb", "zero_overlap_buckets",
+    "zero1_exposed_comm_bytes_serial", "zero1_exposed_comm_bytes_overlap",
+    "zero3_exposed_comm_bytes_serial", "zero3_exposed_comm_bytes_overlap",
+)
+
+
+def test_overlap_analytic_facts_pin_the_acceptance():
+    """The chip-free half of the r14 acceptance: zb's useful-tick
+    fraction strictly exceeds interleaved at the SAME (K, M, V), and
+    the overlapped exposure is strictly below the serial exposure at
+    both ZeRO levels."""
+    out = bench._overlap_analytic_facts(2, 8)
+    for k in _OVERLAP_ANALYTIC_KEYS:
+        assert out[k] is not None, k
+    assert out["pp_zb_useful_tick_fraction"] > \
+        out["pp_interleaved_useful_tick_fraction"] > \
+        out["pp_gpipe_useful_tick_fraction"]
+    for lv in (1, 3):
+        assert out[f"zero{lv}_exposed_comm_bytes_overlap"] < \
+            out[f"zero{lv}_exposed_comm_bytes_serial"]
+
+
+@pytest.mark.slow
+def test_overlap_phase_runs(monkeypatch, ds):
+    monkeypatch.setattr(bench, "PER_CHIP_BATCH", 8)
+    monkeypatch.setattr(bench, "CHUNK", 2)
+    monkeypatch.setattr(bench, "OVERLAP_TIMED_CHUNKS", 1)
+    _shrink_ppep(monkeypatch)
+    monkeypatch.setattr(bench, "PP_NUM_BLOCKS", 8)
+    out = bench.overlap_phase(ds, 8)
+    for key in bench._OVERLAP_RATE_KEYS:
+        assert out[key] is not None and out[key] > 0, key
+    for k in _OVERLAP_ANALYTIC_KEYS:
+        assert out[k] is not None, k
+
+
+def test_overlap_phase_skips_on_one_chip(ds):
+    out = bench.overlap_phase(ds, 1)
+    for key in bench._OVERLAP_RATE_KEYS:
+        assert out[key] is None, key
+    assert "overlap_skipped" in out
+    assert out["pp_zb_useful_tick_fraction"] > \
+        out["pp_interleaved_useful_tick_fraction"]
+
+
+# (the degraded-record assertions for the overlap keys ride the
+# existing test_degraded_record_keeps_zero_facts_non_null record build
+# — one degraded-record construction, not two)

@@ -431,7 +431,18 @@ LOOP_VARIANTS = {
     "pp": ["--model=lm", "--dataset=lm", "--seq_len=32",
            "--vocab_size=16", "--d_model=32", "--num_heads=2",
            "--num_blocks=2", "--model_axis=2", "--pipeline"],
+    # r14: the zero-bubble schedule is its own loop-variant surface
+    # (explicit F/B/W scan, pp_step_zb spans) — it must emit the same
+    # scalar family (zb needs >= 2 blocks per group, hence 4 blocks)
+    "pp_zb": ["--model=lm", "--dataset=lm", "--seq_len=32",
+              "--vocab_size=16", "--d_model=32", "--num_heads=2",
+              "--num_blocks=4", "--model_axis=2", "--pipeline",
+              "--pp_schedule=zb"],
     "zero": ["--zero=1"],
+    # r14: the overlapped-ZeRO collective pattern rides its own spans
+    # (zero_step_overlap) and ledger pricing — same contract
+    "zero_overlap": ["--zero=3", "--zero_overlap",
+                     "--zero_bucket_mb=1"],
 }
 
 # THE scalar contract: every loop variant must emit this full set at
@@ -443,7 +454,7 @@ STANDARD_SCALARS = (
     "mfu", "model_flops_per_sec", "goodput",
     "hbm_in_use_bytes", "hbm_peak_bytes", "hbm_headroom_pct",
     "compiles_total", "compile_time_s", "recompiles_total",
-    "comm_bytes_per_step",
+    "comm_bytes_per_step", "comm_exposed_bytes_per_step",
 )
 
 

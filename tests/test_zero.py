@@ -553,3 +553,203 @@ def test_trace_ops_mem_mode():
     assert "8.00x" in p.stdout  # both reductions on the flagship CNN
     assert "weights/wd1" in p.stdout  # the per-leaf table
     assert "reduce-scatter+all-gather" in p.stdout
+
+
+# ---------------------------------------------- comm/compute overlap
+
+
+def _run_overlap_pair(mesh, model, opt, level, *, steps=3,
+                      keep_prob=0.8, clip=None, bucket_mb=0.05):
+    """Run the serial and --zero_overlap steps side by side on the same
+    batches; the tiny bucket forces MULTI-bucket collectives so the
+    concat/split machinery is actually exercised."""
+    state0 = create_train_state(model, opt, seed=0)
+    batch = shard_batch(mesh, _batch())
+    outs = {}
+    for overlap in (False, True):
+        fn = make_zero_train_step(
+            model, opt, mesh, level, keep_prob=keep_prob, donate=False,
+            grad_transform=zero_clip_transform(clip) if clip else None,
+            overlap=overlap, bucket_mb=bucket_mb)
+        st = shard_state_zero(state0, mesh, level)
+        for _ in range(steps):
+            st, m = fn(st, batch)
+        outs[overlap] = (fetch_state_zero(st, model, level),
+                         float(m["loss"]))
+    return outs
+
+
+@pytest.mark.parametrize("level", [1, 3])
+def test_zero_overlap_bitmatches_serial(mesh, level):
+    """THE r14 acceptance pin: --zero_overlap trajectories are
+    BIT-IDENTICAL to the serial ZeRO path at levels 1 and 3, dropout
+    on — bucketed scatters own the same chunks, the level-3 prefetched
+    gather is the same data movement, and the explicit reduce-scatter
+    equals the serial gather transpose."""
+    outs = _run_overlap_pair(mesh, DeepCNN(), adam(1e-3), level)
+    assert outs[False][1] == outs[True][1]
+    _assert_trees_equal(outs[False][0].params, outs[True][0].params)
+    _assert_trees_equal(outs[False][0].opt_state, outs[True][0].opt_state)
+
+
+@pytest.mark.parametrize("level", [1, 3])
+def test_zero_overlap_clipped_bitmatches_serial(mesh, level):
+    """--clip_norm composes: the axis-aware transform sees the same
+    scattered chunks either way, so even the CLIPPED trajectory stays
+    bitwise equal between overlap and serial (this is overlap-vs-serial
+    at the SAME level — not the cross-level float-tolerance case)."""
+    outs = _run_overlap_pair(mesh, DeepCNN(), adam(1e-3), level,
+                             clip=0.05)
+    _assert_trees_equal(outs[False][0].params, outs[True][0].params)
+
+
+@pytest.mark.parametrize("level", [1, 3])
+def test_zero_overlap_device_step_bitmatches_serial(mesh, level):
+    """The --device_data composition: the overlap chunk scan (level 3:
+    warmup gather + double-buffered prefetch carried across scan
+    iterations) lands on bit-identical params vs the serial chunked
+    step — the prefetched full params are the same values the serial
+    step would re-gather."""
+    from distributed_tensorflow_tpu.data import read_data_sets
+    from distributed_tensorflow_tpu.data.device_data import (
+        put_device_data,
+    )
+    from distributed_tensorflow_tpu.training.device_step import (
+        make_zero_device_train_step,
+    )
+
+    model = DeepCNN()
+    opt = adam(1e-3)
+    state0 = create_train_state(model, opt, seed=0)
+    ds = read_data_sets("/tmp/mnist-data", one_hot=True)
+    data = put_device_data(ds.train, mesh)
+    outs = {}
+    for overlap in (False, True):
+        fn = make_zero_device_train_step(
+            model, opt, mesh, level, 32, keep_prob=0.8, chunk=3,
+            donate=False, overlap=overlap, bucket_mb=0.05)
+        st = shard_state_zero(state0, mesh, level)
+        st, m = fn(st, data)
+        outs[overlap] = (fetch_state_zero(st, model, level),
+                         float(m["loss"]))
+    assert outs[False][1] == outs[True][1]
+    _assert_trees_equal(outs[False][0].params, outs[True][0].params)
+    _assert_trees_equal(outs[False][0].opt_state,
+                        outs[True][0].opt_state)
+
+
+def test_bucketed_collectives_match_per_leaf(mesh):
+    """The mechanism pin under the trajectory pins: a bucketed
+    reduce-scatter owns EXACTLY the per-leaf scatters' chunks (same
+    padding, same [D, c] row ownership, same elementwise sums), and
+    the bucketed gather reassembles exactly what the per-leaf gathers
+    would — on a ragged tree whose leaves straddle bucket boundaries."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel.zero import (
+        _bucket_plan,
+        _gather_bucketed,
+        _gather_params,
+        _scatter_bucketed,
+        _scatter_leaf,
+    )
+
+    tree = {
+        "a": jax.random.normal(jax.random.key(0), (13,)),
+        "b": jax.random.normal(jax.random.key(1), (3, 5)),
+        "c": jax.random.normal(jax.random.key(2), (100,)),
+        "d": jnp.float32(2.5),  # scalar leaf pads to one chunk each
+    }
+    meta = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.float32), tree)
+    # 3 tiny buckets out of 4 leaves: the plan actually groups
+    plan = _bucket_plan(jax.tree.leaves(meta), 8, 60 * 4)
+    assert len(plan) == 3
+
+    def pair(x):
+        per = jax.tree.map(_scatter_leaf, x)
+        buck = _scatter_bucketed(x, 8, 60 * 4)
+        gper = _gather_params(per, meta)
+        gbuck = _gather_bucketed(per, meta, 8, 60 * 4)
+        return per, buck, gper, gbuck
+
+    per, buck, gper, gbuck = jax.jit(jax.shard_map(
+        pair, mesh=mesh, in_specs=(P(),),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        check_vma=False))(tree)
+    for a, b in zip(jax.tree.leaves(per), jax.tree.leaves(buck)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(gper), jax.tree.leaves(gbuck)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_zero_comm_rows_overlap_exposure():
+    """The ledger's overlap pricing: serial rows expose everything;
+    overlap exposes one bucket per collective, drops the level-3 remat
+    re-gather row entirely (|G|+2|P| -> |G|+|P| on the wire), and
+    prices the prefetched gather at zero exposure."""
+    from distributed_tensorflow_tpu.parallel.zero import (
+        zero_comm_rows,
+        zero_exposed_comm_bytes,
+    )
+
+    G = 10 * 2 ** 20
+    bucket = 1.0  # MB
+    serial3 = zero_comm_rows(G, G, 3, 8)
+    assert sum(r["bytes"] for r in serial3) == 3 * G
+    assert all(r["exposed_bytes"] == r["bytes"] for r in serial3)
+    over3 = zero_comm_rows(G, G, 3, 8, overlap=True, bucket_mb=bucket)
+    assert sum(r["bytes"] for r in over3) == 2 * G  # remat gather gone
+    gather = [r for r in over3 if "prefetched" in r["collective"]]
+    assert gather and gather[0]["exposed_bytes"] == 0
+    assert zero_exposed_comm_bytes(G, G, 3, 8, True, bucket) == 2 ** 20
+    over1 = zero_comm_rows(G, G, 1, 8, overlap=True, bucket_mb=bucket)
+    assert sum(r["bytes"] for r in over1) == 2 * G
+    assert zero_exposed_comm_bytes(G, G, 1, 8, True, bucket) == 2 * 2 ** 20
+    # a 1-way data axis still moves nothing
+    assert zero_comm_rows(G, G, 3, 1, overlap=True) == []
+
+
+def test_zero_overlap_flag_validation():
+    """Parse-time --zero_overlap/--zero_bucket_mb validation: the
+    overlap flag needs its parent mode, the bucket size needs the
+    overlap flag and sane bounds — named at the command line."""
+    from distributed_tensorflow_tpu import flags
+
+    flags.define_reference_flags()
+    cases = [
+        (["--zero_overlap"], "only applies to --zero"),
+        (["--zero=1", "--zero_overlap", "--zero_bucket_mb=0"],
+         "must be in"),
+        (["--zero=1", "--zero_overlap", "--zero_bucket_mb=2048"],
+         "must be in"),
+        (["--zero=1", "--zero_bucket_mb=8"],
+         "only applies with"),
+    ]
+    try:
+        for args, want in cases:
+            flags.FLAGS._reset()
+            with pytest.raises(ValueError, match=want):
+                flags.FLAGS._parse(args)
+        flags.FLAGS._reset()
+        flags.FLAGS._parse(["--zero=3", "--zero_overlap",
+                            "--zero_bucket_mb=8"])
+        assert flags.FLAGS.zero_overlap is True
+        assert flags.FLAGS.zero_bucket_mb == 8.0
+    finally:
+        flags.FLAGS._reset()
+
+
+def test_trace_ops_comm_overlap_mode():
+    """tools/trace_ops.py --comm ... --zero_overlap prints the exposed
+    column and the prefetched-gather row — no chip."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "trace_ops.py"),
+         "--comm", "deep_cnn", "8", "--zero_overlap", "--bucket_mb", "1"],
+        capture_output=True, text=True, timeout=300, cwd=root, env=env)
+    assert p.returncode == 0, p.stderr
+    assert "exposed" in p.stdout
+    assert "all_gather(params, prefetched)" in p.stdout
+    assert "bucketed reduce-scatter" in p.stdout

@@ -7,12 +7,14 @@ RPC-latency-polluted microbenchmarks are ~10x wrong. Loads the newest
 ``*.trace.json.gz`` under a profile dir, selects the XLA Ops thread,
 and prints a table: op name, calls, total ms, share, bytes accessed.
 
-``--schedule K M [V]`` instead prints the static pipeline tick table
-the --pipeline step compiles for K stages x M microbatches x V virtual
-stage groups (parallel/pp_schedule.py — GPipe when V=1, interleaved
-when V>1), with the per-stage useful-tick fraction and total scheduled
-block-group computations: the masked-tick cost model at a glance, no
-chip required.
+``--schedule K M [V] [gpipe|interleaved|zb]`` instead prints the static
+pipeline tick table the --pipeline step compiles for K stages x M
+microbatches x V virtual stage groups (parallel/pp_schedule.py — GPipe
+when V=1, interleaved when V>1), with the per-stage useful-tick
+fraction and total scheduled block-group computations: the masked-tick
+cost model at a glance, no chip required. ``zb`` prints the combined
+zero-bubble F/B/W table (B and W ticks distinguished) with the
+useful-fraction comparison against the interleaved baseline.
 
 ``--faults`` lists every registered fault-injection point with the
 --fault_spec grammar (utils/faults.py) — how the spec strings are
@@ -42,11 +44,12 @@ per mode, no chip. The --mem/--flops printers' third sibling: memory,
 compute, and now the wire.
 
 Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
-       python tools/trace_ops.py --schedule K M [V]
+       python tools/trace_ops.py --schedule K M [V] [gpipe|interleaved|zb]
        python tools/trace_ops.py --faults
        python tools/trace_ops.py --mem MODEL D [--zero Z] [--optimizer OPT]
        python tools/trace_ops.py --flops MODEL [BATCH]
        python tools/trace_ops.py --comm MODEL D [--model_axis K] [--batch B]
+                                 [--zero_overlap] [--bucket_mb N]
 """
 
 from __future__ import annotations
@@ -104,19 +107,29 @@ def aggregate(events: list[dict]) -> list[dict]:
 
 
 def print_schedule(k_stages: int, microbatches: int,
-                   virtual_stages: int = 1) -> None:
+                   virtual_stages: int = 1,
+                   schedule: str = "auto") -> None:
     """Print the static (K, M, V) pipeline tick table + schedule cost
     facts — the same builder the compiled step closes over, so what
-    prints here IS what runs."""
+    prints here IS what runs. ``schedule="zb"`` prints the combined
+    zero-bubble F/B/W table with B and W ticks distinguished (and the
+    useful-fraction comparison against the interleaved baseline)."""
     import os
     import sys as _sys
 
     _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     from distributed_tensorflow_tpu.parallel.pp_schedule import (
         build_pp_schedule,
+        build_zb_schedule,
         format_schedule,
+        format_zb_schedule,
+        normalize_pp_schedule,
     )
 
+    if normalize_pp_schedule(schedule, virtual_stages) == "zb":
+        print(format_zb_schedule(
+            build_zb_schedule(k_stages, microbatches, virtual_stages)))
+        return
     sched = build_pp_schedule(k_stages, microbatches, virtual_stages)
     print(format_schedule(sched))
     per_group = f"num_blocks/{k_stages * virtual_stages}"
@@ -244,12 +257,16 @@ def print_flops(model_name: str, batch: int = 128) -> None:
 
 
 def print_comm(model_name: str, d: int, model_axis: int = 2,
-               batch: int = 128) -> None:
+               batch: int = 128, zero_overlap: bool = False,
+               bucket_mb: float = 4.0) -> None:
     """Print the static per-step collective-comm ledger for every mode
     that applies to ``MODEL`` on ``D`` chips — the same
     ``utils/resources.comm_ledger`` accounting behind every loop's
     ``comm_bytes_per_step`` scalar, so what prints here IS what the
-    metrics report. No chip (jax.eval_shape only)."""
+    metrics report. No chip (jax.eval_shape only). ``--zero_overlap``
+    [--bucket_mb N] prices the ZeRO rows under the bucketed/prefetched
+    overlap pattern — the exposed column shows what stays on the
+    critical path."""
     import os
     import sys as _sys
 
@@ -271,21 +288,35 @@ def print_comm(model_name: str, d: int, model_axis: int = 2,
     if is_tf and d >= model_axis:
         dw = max(1, d // model_axis)
         modes += [("pp", dict(data_ways=dw, model_axis=model_axis)),
+                  ("pp-zb", dict(data_ways=dw, model_axis=model_axis)),
                   ("tp", dict(data_ways=dw, model_axis=model_axis)),
                   ("sp", dict(data_ways=dw, model_axis=model_axis))]
     print(f"static per-step comm ledger — model={model_name} D={d} "
           f"batch={batch}"
           + (f" model_axis={model_axis}" if is_tf else "")
+          + (f" zero_overlap bucket={bucket_mb:g}MB" if zero_overlap
+             else "")
           + " (analytic; all-reduce ~2|G|, reduce-scatter |G|, "
             "all-gather |P|)")
     for mode, cfg in modes:
-        led = comm_ledger(model, None, batch, mode=mode, **cfg)
-        print(f"\n{mode} (data x model = {led['data_ways']} x "
+        kw = dict(cfg)
+        if mode == "pp-zb":
+            mode, kw["pp_schedule"] = "pp", "zb"
+            label = "pp (zb)"
+        else:
+            label = mode
+        if mode.startswith("zero") and zero_overlap:
+            kw.update(zero_overlap=True, zero_bucket_mb=bucket_mb)
+        led = comm_ledger(model, None, batch, mode=mode, **kw)
+        print(f"\n{label} (data x model = {led['data_ways']} x "
               f"{led['model_axis']}): "
-              f"{_fmt_bytes(led['comm_bytes_per_step'])}/step")
+              f"{_fmt_bytes(led['comm_bytes_per_step'])}/step, "
+              f"{_fmt_bytes(led['comm_exposed_bytes_per_step'])} exposed")
         for r in led["rows"]:
-            print(f"  {r['collective']:<40} {r['axis']:<6} "
-                  f"{_fmt_bytes(r['bytes']):>12}  {r.get('note', '')}")
+            print(f"  {r['collective']:<42} {r['axis']:<6} "
+                  f"{_fmt_bytes(r['bytes']):>12} "
+                  f"{_fmt_bytes(r.get('exposed_bytes', r['bytes'])):>12}"
+                  f"  {r.get('note', '')}")
         if not led["rows"]:
             print("  (no collectives — single-chip layout)")
 
@@ -322,8 +353,13 @@ def main(profile_dir: str, top_n: int = 25) -> None:
 if __name__ == "__main__":
     if sys.argv[1] == "--schedule":
         k, m = int(sys.argv[2]), int(sys.argv[3])
-        v = int(sys.argv[4]) if len(sys.argv) > 4 else 1
-        print_schedule(k, m, v)
+        rest = sys.argv[4:]
+        sched = "auto"
+        if rest and not rest[-1].isdigit():
+            sched = rest[-1]
+            rest = rest[:-1]
+        v = int(rest[0]) if rest else 1
+        print_schedule(k, m, v, sched)
     elif sys.argv[1] == "--faults":
         print_faults()
     elif sys.argv[1] == "--flops":
@@ -333,6 +369,8 @@ if __name__ == "__main__":
         rest = sys.argv[2:]
         model_axis = 2
         batch = 128
+        zero_overlap = False
+        bucket_mb = 4.0
         if "--model_axis" in rest:
             i = rest.index("--model_axis")
             model_axis = int(rest[i + 1])
@@ -341,8 +379,15 @@ if __name__ == "__main__":
             i = rest.index("--batch")
             batch = int(rest[i + 1])
             rest = rest[:i] + rest[i + 2:]
+        if "--bucket_mb" in rest:
+            i = rest.index("--bucket_mb")
+            bucket_mb = float(rest[i + 1])
+            rest = rest[:i] + rest[i + 2:]
+        if "--zero_overlap" in rest:
+            rest.remove("--zero_overlap")
+            zero_overlap = True
         print_comm(rest[0], int(rest[1]) if len(rest) > 1 else 8,
-                   model_axis, batch)
+                   model_axis, batch, zero_overlap, bucket_mb)
     elif sys.argv[1] == "--mem":
         rest = sys.argv[2:]
         zero_level = None
