@@ -1698,6 +1698,95 @@ def recovery_phase() -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def elastic_phase() -> dict:
+    """Elastic-resize drill (r15): drive the detect -> drain -> adopt ->
+    restore ladder end to end on a tiny host state — the REAL machinery
+    (the ``preempt`` injection point, ``ElasticSupervisor.poll``/
+    ``maybe_resize``, sentinel-snapshot adoption, the CRC-verified
+    fallback restore, the membership epoch in cluster.py). HOST-ONLY
+    (no mesh, no compiled step), so the ``elastic_*`` facts stay
+    NON-NULL even in the degraded/outage record, per the bench
+    contract: the robustness trajectory keeps resize evidence through
+    tunnel outages. The scenario is the lost-step worst case: an
+    IMMEDIATE preemption (no drain save) whose sentinel emergency
+    snapshot is newer than the last cadenced checkpoint but lands torn
+    (the capacity died mid-write), so adoption AND the fallback ladder
+    both engage."""
+    import os
+    import shutil
+    import sys
+    import tempfile
+    import types
+
+    import numpy as np
+
+    from distributed_tensorflow_tpu import cluster
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        restore_with_fallback,
+        save_checkpoint,
+    )
+    from distributed_tensorflow_tpu.training import elastic
+    from distributed_tensorflow_tpu.utils import faults
+
+    d = tempfile.mkdtemp(prefix="bench-elastic-")
+    try:
+        t0 = time.perf_counter()
+        flags_ns = types.SimpleNamespace(logdir=d, worker_hosts="",
+                                         task_index=0, world_size=2,
+                                         elastic=True)
+        with contextlib.redirect_stdout(sys.stderr):  # stdout stays JSON
+            # a fresh elastic run at a 2-member world (resets the
+            # handled-departure registry, so the drill is re-runnable)
+            elastic.begin_run(flags_ns)
+            faults.configure(
+                "preempt:at_step=10:mode=immediate:host=1")
+            es = elastic.ElasticSupervisor()
+            assert not es.poll(8)   # unarmed boundary: no change
+            assert es.poll(10)      # the preemption fires here
+            state = {"params": {"w": np.arange(65536, dtype=np.float32)},
+                     "step": np.int64(0)}
+            # the last cadenced checkpoint (step 8) predates the loss
+            save_checkpoint(d, dict(state, step=np.int64(8)), 8)
+            # the sentinel's last-good emergency snapshot is newer...
+            save_checkpoint(os.path.join(d, "sentinel"),
+                            dict(state, step=np.int64(10)), 10)
+            try:
+                es.maybe_resize(12)
+                raise AssertionError("maybe_resize did not resize")
+            except elastic.ResizeRequired as rz:
+                elastic.apply_resize(rz, flags_ns)  # adopts the snapshot
+                drain_steps = rz.drain_steps
+            # ...but landed torn (the capacity died mid-write): the
+            # ladder must quarantine it and walk back to step 8
+            adopted = os.path.join(d, "ckpt-10.npz")
+            with open(adopted, "r+b") as f:
+                f.truncate(os.path.getsize(adopted) // 2)
+            out = restore_with_fallback(d, state)
+            assert out is not None
+            _, restore_step, report = out
+            elastic.book_resize(None, None, restore_step)  # close+record
+        return {
+            "elastic_world": "2->1",
+            "elastic_epoch": cluster.membership_epoch(),
+            "elastic_drain_steps": int(drain_steps),
+            "elastic_restore_step": int(restore_step),
+            "elastic_restore_fallback_depth": int(report.fallback_depth),
+            "elastic_resize_s": round(time.perf_counter() - t0, 4),
+        }
+    except Exception as e:  # never kill the record over the drill
+        return {"elastic_world": None,
+                "elastic_epoch": None,
+                "elastic_drain_steps": None,
+                "elastic_restore_step": None,
+                "elastic_restore_fallback_depth": None,
+                "elastic_resize_s": None,
+                "elastic_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        faults.reset()
+        cluster.reset_membership()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 # Outage resilience (round-4 lesson: the tunnel was down at the driver's
 # capture time and the artifact became rc=1 with a bare stack trace —
 # BENCH_r04.json). Backend init is probed in a SUBPROCESS because during
@@ -1859,6 +1948,9 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
     # and the live sample/compile drill run on the CPU fallback, so
     # every resources_* field stays non-null in the outage record too
     out.update(resources_phase())
+    # r15: the elastic-resize drill is host-only like the recovery
+    # drill — detect/adopt/restore facts stay non-null through outages
+    out.update(elastic_phase())
     if partial:
         out.update(partial)
     return out
@@ -1976,6 +2068,9 @@ def _run_phases(out: dict):
     # r13: the resource plane — live-vs-analytic HBM, the compile
     # drill, and the analytic comm-ledger bytes
     out.update(resources_phase())
+    # r15: the elastic-resize drill (host-only; also runs in the
+    # degraded record so the elastic facts are never null)
+    out.update(elastic_phase())
 
     print(json.dumps(out))
 

@@ -52,6 +52,101 @@ class ClusterSpec:
         return len(self.jobs.get(job, []))
 
 
+# ---------------------------------------------------------- membership
+#
+# The elastic-training world registry (r15): which members of the
+# launch-time world are CURRENTLY in it, and the monotonically
+# increasing epoch every membership change advances. Single-process
+# runs treat each local device as a world member ("device-hosts" — the
+# virtual topology the CPU test mesh already simulates); multi-process
+# runs treat each process as a member and re-form the runtime through
+# ``maybe_initialize_distributed`` at the new size. The registry lives
+# HERE because membership is cluster state: ``parallel.mesh.make_mesh``
+# consults ``active_devices`` so every mesh any loop builds covers
+# exactly the current world, and ``training/elastic.py`` drives the
+# transitions.
+
+# hosts: tuple[int] | None = full launch world. Member ids are LAUNCH
+# ids and stay stable across resizes — after a multi-host re-form the
+# runtime renumbers process indices 0..P-1, so the launch topology
+# (worker list + this process's launch id) is recorded here and every
+# membership decision maps through it instead of the shifting ranks.
+_MEMBERSHIP = {"epoch": 0, "hosts": None, "self_host": None,
+               "launch_workers": None}
+
+
+def reset_membership() -> None:
+    """Back to the full launch-time world at epoch 0 (run entry, tests)."""
+    _MEMBERSHIP["epoch"] = 0
+    _MEMBERSHIP["hosts"] = None
+    _MEMBERSHIP["self_host"] = None
+    _MEMBERSHIP["launch_workers"] = None
+
+
+def set_launch_topology(workers, self_host: int) -> None:
+    """Record the immutable launch worker list and THIS process's
+    launch member id (train()'s elastic wrapper calls this at run
+    entry). Survivor re-forms resolve addresses and self-identity
+    against these, never against the post-resize renumbering."""
+    _MEMBERSHIP["launch_workers"] = tuple(workers or ())
+    _MEMBERSHIP["self_host"] = int(self_host)
+
+
+def launch_workers() -> tuple:
+    return _MEMBERSHIP["launch_workers"] or ()
+
+
+def self_host(default: int = 0) -> int:
+    """This process's LAUNCH member id (stable across resizes)."""
+    sh = _MEMBERSHIP["self_host"]
+    return int(sh) if sh is not None else int(default)
+
+
+def membership_epoch() -> int:
+    return _MEMBERSHIP["epoch"]
+
+
+def set_world(hosts, epoch: int | None = None) -> int:
+    """Install a new membership: ``hosts`` are world-member indices
+    (device slots single-process, process ids multi-process); ``epoch``
+    defaults to the next one. Returns the installed epoch."""
+    hosts = tuple(sorted(int(h) for h in hosts))
+    if not hosts:
+        raise ValueError("membership change would empty the world — the "
+                         "last member cannot be preempted")
+    _MEMBERSHIP["hosts"] = hosts
+    _MEMBERSHIP["epoch"] = (int(epoch) if epoch is not None
+                            else _MEMBERSHIP["epoch"] + 1)
+    return _MEMBERSHIP["epoch"]
+
+
+def world_hosts(default_size: int) -> tuple:
+    """Current member indices (``default_size`` fills in the launch
+    world when no membership change has happened yet)."""
+    hosts = _MEMBERSHIP["hosts"]
+    return hosts if hosts is not None else tuple(range(default_size))
+
+
+def active_devices():
+    """The devices the current world owns — what ``make_mesh`` builds
+    over. Multi-process worlds resize by re-initializing the runtime
+    (every process then sees the survivors' devices as jax.devices()),
+    so the filter applies only to the single-process device-host
+    topology."""
+    import jax
+
+    devs = jax.devices()
+    hosts = _MEMBERSHIP["hosts"]
+    if hosts is None or jax.process_count() > 1:
+        return devs
+    bad = [h for h in hosts if h >= len(devs)]
+    if bad:
+        raise ValueError(
+            f"world members {bad} exceed the {len(devs)} local devices "
+            f"(--world_size larger than the host?)")
+    return [devs[h] for h in hosts]
+
+
 def resolve_mode(FLAGS) -> str:
     """Demux --mode=auto: reference-style role launch (--ps_hosts set) means
     ps emulation; otherwise sync DP over local devices."""
@@ -107,10 +202,24 @@ def _initialize_with_retry(init_fn, *, retries: int, backoff_s: float,
             sleep(delay)
 
 
+def _epoch_coordinator(coordinator: str, epoch: int) -> str:
+    """Namespace the coordination service by membership epoch: the port
+    offsets by ``epoch``, so a stale peer still dialing (or holding) the
+    previous epoch's service can never join — or wedge — the re-formed
+    world. Epoch 0 is byte-identical to the pre-elastic behavior."""
+    if not epoch:
+        return coordinator
+    host, _, port = coordinator.rpartition(":")
+    if not host or not port.isdigit():
+        return coordinator
+    return f"{host}:{int(port) + int(epoch)}"
+
+
 def maybe_initialize_distributed(cluster: ClusterSpec, task_index: int,
                                  init_retries: int = 0,
                                  init_backoff_s: float = 2.0,
-                                 init_timeout_s: float = 0.0) -> bool:
+                                 init_timeout_s: float = 0.0,
+                                 membership_epoch: int = 0) -> bool:
     """Multi-host sync mode: join the JAX coordination service over DCN.
 
     Worker 0's host acts as coordinator (the role the chief's master service
@@ -123,6 +232,14 @@ def maybe_initialize_distributed(cluster: ClusterSpec, task_index: int,
     dying on the first connection refusal. ``init_timeout_s`` > 0 caps
     each attempt's in-library wait (jax's ``initialization_timeout``,
     default 300 s) so retry attempts turn over fast enough to matter.
+
+    ``membership_epoch`` > 0 is the elastic re-form path (training/
+    elastic.py): survivors of a membership change re-initialize at the
+    new world size against an epoch-namespaced coordination service
+    (``_epoch_coordinator`` offsets the port), so a stale peer from the
+    previous epoch cannot race the re-formed cluster; every retry/
+    backoff line names the epoch so interleaved relaunch logs stay
+    attributable.
     """
     workers = cluster.worker_hosts
     if len(workers) <= 1:
@@ -140,7 +257,8 @@ def maybe_initialize_distributed(cluster: ClusterSpec, task_index: int,
         except Exception:  # noqa: BLE001 — older jax: no such flag, no need
             pass
 
-    coordinator = workers[0]
+    coordinator = _epoch_coordinator(workers[0],
+                                     int(membership_epoch or 0))
     kwargs = dict(
         coordinator_address=coordinator,
         num_processes=len(workers),
@@ -178,12 +296,15 @@ def maybe_initialize_distributed(cluster: ClusterSpec, task_index: int,
 
     from distributed_tensorflow_tpu.utils import telemetry
 
+    epoch_tag = (f" [membership epoch {int(membership_epoch)}]"
+                 if membership_epoch else "")
     with telemetry.trace_span("cluster_init", coordinator=coordinator,
-                              process=int(task_index)):
+                              process=int(task_index),
+                              epoch=int(membership_epoch or 0)):
         _initialize_with_retry(
             _init, retries=max(0, int(init_retries)),
             backoff_s=float(init_backoff_s),
-            what=f"jax.distributed.initialize({coordinator})",
+            what=f"jax.distributed.initialize({coordinator}){epoch_tag}",
             cleanup_fn=_cleanup)
     # every process leaves initialize() once the coordinator has all
     # members — a coarse first clock anchor for the fleet timeline

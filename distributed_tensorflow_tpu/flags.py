@@ -537,6 +537,30 @@ def define_reference_flags():
                    "for fleet_report/the OOM postmortem. 0 = off. "
                    "Rides the telemetry spine (--telemetry=false "
                    "disables it)")
+    DEFINE_boolean("elastic", False, "Elastic, preemption-tolerant "
+                   "training (training/elastic.py): on a membership "
+                   "change — a spot preemption modeled by the "
+                   "'preempt' fault point, or a departure bit on the "
+                   "multi-host coordinator vote — the run drains to "
+                   "the next checkpoint boundary (a verified-save "
+                   "drain checkpoint; an 'immediate' preemption loses "
+                   "the step and falls back to the last checkpoint or "
+                   "the sentinel's emergency snapshot), re-forms the "
+                   "mesh at the new world size, restores the standard-"
+                   "layout checkpoint into the rescaled DP/ZeRO "
+                   "layout, and continues — bitwise on the trajectory "
+                   "a fresh run restored at the target shape would "
+                   "take. The resize downtime lands as the goodput "
+                   "ledger's named resize_s charge plus "
+                   "membership_change/resize spans. Auto-armed "
+                   "whenever --fault_spec names the preempt point")
+    DEFINE_integer("world_size", 0, "Launch-world size for elastic "
+                   "training: cap the run to this many world members "
+                   "(single-process: local devices — the device-host "
+                   "topology; multi-process: processes). 0 = the full "
+                   "device/process set. A smaller launch world leaves "
+                   "headroom for a resize to GROW into (the re-add "
+                   "half of the elastic story)")
     DEFINE_integer("recompile_budget", 0, "Recompilation sentry "
                    "(utils/resources.CompileSentry): if > 0, more than "
                    "this many traced-signature recompiles inside a "
@@ -547,6 +571,7 @@ def define_reference_flags():
                    "recompiles_total scalars are always emitted while "
                    "telemetry is on")
     FLAGS._register_validator(_validate_pipeline_flags)
+    FLAGS._register_validator(_validate_elastic_flags)
     FLAGS._register_validator(_validate_zero_flags)
     FLAGS._register_validator(_validate_fault_spec)
     FLAGS._register_validator(_validate_telemetry_flags)
@@ -862,6 +887,30 @@ def _validate_resource_flags(values: dict):
             "--hbm_sample_every > 1 with --telemetry=false is silently "
             "inert (HBM sampling rides the telemetry spine; "
             "--telemetry=false already disables it) — drop one")
+
+
+def _validate_elastic_flags(values: dict):
+    """Parse-time elastic-surface validation (the PR-2
+    _register_validator pattern): a negative world, or elasticity armed
+    on the asynchronous ps topology (whose membership is the reference's
+    static ClusterSpec — there is no mesh to re-form), surfaces at the
+    command line with the flags named."""
+    ws = values.get("world_size")
+    if ws is not None and int(ws) < 0:
+        raise ValueError(f"--world_size={ws} must be >= 0 (0 = the full "
+                         f"device/process set)")
+    el = bool(values.get("elastic"))
+    spec = values.get("fault_spec") or ""
+    preempt_armed = "preempt" in spec
+    if not (el or preempt_armed):
+        return
+    mode = values.get("mode") or "auto"
+    if mode == "ps" or values.get("ps_hosts"):
+        raise ValueError(
+            "--elastic (or a --fault_spec preempt rule) with the ps "
+            "topology is not supported: ps membership is the "
+            "reference's static ClusterSpec and there is no device "
+            "mesh to re-form — use --mode=sync")
 
 
 def _validate_fault_spec(values: dict):

@@ -50,9 +50,17 @@ def make_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
     Device order follows ``jax.devices()`` which enumerates chips in
     ICI-neighbor order on TPU slices, so the data axis maps onto physical
     rings and ``psum`` stays on ICI.
+
+    "Available" means the CURRENT elastic world (cluster.active_devices):
+    after a membership change the survivors' re-built meshes cover
+    exactly the resized device set — with no membership registered (the
+    default, and every pre-elastic caller) this is ``jax.devices()``
+    unchanged.
     """
     if devices is None:
-        devices = jax.devices()
+        from distributed_tensorflow_tpu.cluster import active_devices
+
+        devices = active_devices()
     spec = spec or MeshSpec()
     data, model = spec.resolve(len(devices))
     arr = np.asarray(devices).reshape(data, model)

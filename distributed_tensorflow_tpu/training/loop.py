@@ -46,6 +46,7 @@ from distributed_tensorflow_tpu.training import (
     make_train_step,
     schedule_from_flags,
 )
+from distributed_tensorflow_tpu.training import elastic
 from distributed_tensorflow_tpu.training.supervisor import Supervisor
 from distributed_tensorflow_tpu.training.train_state import evaluate
 from distributed_tensorflow_tpu.utils import (
@@ -138,6 +139,9 @@ def _log_recovery(sv, logger, step: int, eff=None) -> None:
     })
     if eff is not None and rep is not None:
         eff.charge(rep.time_s, "restore")
+    # a re-formed elastic world books its resize downtime here — right
+    # after the restore that downtime paid for (no-op otherwise)
+    elastic.book_resize(eff, logger, step)
 
 
 class _charged:
@@ -253,7 +257,33 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     its own slice of the global batch (assembled in ``shard_batch``) and
     draws from an independently-seeded shuffle, matching the reference's
     per-worker input semantics (``MNISTDist.py:167,178``).
-    """
+
+    This is the ELASTIC wrapper (r15): the actual run lives in
+    ``_train_once``. When the elasticity supervisor detects a membership
+    change (a ``preempt`` fault, or — multi-host — a departure bit on
+    the coordinator vote), the loop drains to a checkpoint boundary and
+    raises ``ResizeRequired``; this wrapper records the change, installs
+    the new world/epoch (``training/elastic.apply_resize``), and
+    re-enters the loop — which RESTORES the drain checkpoint through
+    the cross-topology machinery and continues at the new world size,
+    bitwise on the trajectory a fresh run restored at that shape would
+    take. A preempted process in a multi-host world exits here with a
+    stub result instead (``Departed``)."""
+    elastic.begin_run(FLAGS)
+    while True:
+        try:
+            return _train_once(FLAGS, mode)
+        except elastic.ResizeRequired as rz:
+            elastic.apply_resize(rz, FLAGS)
+        except elastic.Departed as d:
+            print("Optimization Finished!")
+            return TrainResult(final_step=d.step, train_metrics={},
+                               test_metrics=None, images_per_sec=0.0,
+                               images_per_sec_per_chip=0.0, n_chips=0)
+
+
+def _train_once(FLAGS, mode: str = "local") -> TrainResult:
+    """One membership epoch of a training run (see ``train``)."""
     from distributed_tensorflow_tpu.utils import faults
 
     faults.configure_from_flags(FLAGS)
@@ -722,12 +752,14 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
     rmon = resources.monitor_from_flags(FLAGS, model, opt,
                                         FLAGS.batch_size, n_chips)
     snt = _sentinel_for(FLAGS, sv, logger)
+    els = elastic.supervisor_from_flags(FLAGS)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
                                         full_eval=sp_full_eval, eff=eff)
 
     coord = (_HostCoordinator(sv, coord_steps_from_flags(FLAGS),
-                              stimer=stimer, logger=logger)
+                              stimer=stimer, logger=logger,
+                              elastic_sv=els)
              if (mode == "sync" and n_procs > 1) else None)
     should_stop = coord.should_stop if coord is not None else sv.should_stop
 
@@ -832,6 +864,11 @@ def train(FLAGS, mode: str = "local") -> TrainResult:
                 else:
                     with _charged(eff, "ckpt"):
                         sv.maybe_checkpoint(state, step)
+                if els is not None and els.poll(step):
+                    # membership change due: the StateBox already holds
+                    # this boundary's state — drain via the managed-exit
+                    # save and re-form (raises ResizeRequired)
+                    els.maybe_resize(step)
             jax.block_until_ready(state.params)
         finally:
             if profiling:
@@ -1137,7 +1174,8 @@ class _HostCoordinator:
     milliseconds of compute — and the final checkpoint still lands at the
     agreed exit step."""
 
-    def __init__(self, sv, every: int, stimer=None, logger=None):
+    def __init__(self, sv, every: int, stimer=None, logger=None,
+                 elastic_sv=None):
         import numpy as np
         from jax.experimental import multihost_utils
 
@@ -1147,6 +1185,12 @@ class _HostCoordinator:
         self._boundary = None
         self._np = np
         self._allgather = multihost_utils.process_allgather
+        # elastic membership (r15): the vote carries each host's
+        # liveness/departure bit, so a preemption notice on ONE host
+        # becomes an agreed membership change on EVERY host at the same
+        # boundary — epoch agreement rides the existing allgather, no
+        # new collectives
+        self._els = elastic_sv
         # straggler attribution (r12): the vote carries each host's mean
         # work-per-step (StepTimer.cumulative_work — host_wait+dispatch,
         # the column a straggler burns while its peers wait in the
@@ -1174,8 +1218,12 @@ class _HostCoordinator:
     def tick(self, state, step: int) -> None:
         """Call once per loop iteration, after ``step`` advanced. At each
         boundary: one allgather of [stop?, chief-save-due?, token,
-        work_us]; any stop vote stops everyone, a save vote routes every
-        process into the coordinated checkpoint. The token column
+        work_us, departing?]; any stop vote stops everyone, a save vote
+        routes every process into the coordinated checkpoint, and any
+        departure bit delivers an agreed membership change to the
+        elasticity supervisor (every host sees the same column, so all
+        survivors install the same epoch at the same boundary — the
+        drain then rides the normal exit machinery). The token column
         (random per process, row 0's wins) is the sharded checkpoint's
         per-attempt nonce — agreed HERE so the save itself stays
         collective-free. The work_us column is each host's mean
@@ -1191,13 +1239,16 @@ class _HostCoordinator:
             return
         self._boundary = boundary
         work_us = self._work_us_per_step()
+        depart = (self._els.local_departure_bit()
+                  if self._els is not None else 0)
         with trace_span("coord_vote", step=step), \
                 telemetry.armed("coord_vote_allgather", step=step):
             votes = self._allgather(self._np.asarray(
                 [self._sv.should_stop(),
                  self._sv.checkpointer.cadence_due(),
                  secrets.randbits(31),
-                 work_us],
+                 work_us,
+                 depart],
                 self._np.int32))
         # all hosts leave the allgather within network-jitter of each
         # other: the wall/monotonic pair sampled HERE is the per-host
@@ -1210,11 +1261,16 @@ class _HostCoordinator:
         telemetry.get_tracer().record_instant(
             "coord_clock", boundary=int(boundary), step=int(step),
             mono=time.monotonic(), work_us=int(work_us))
-        votes = votes.reshape(-1, 4)
+        votes = votes.reshape(-1, 5)
         if votes[:, 1].max():
             self._sv.checkpoint_coordinated(
                 state, step, attempt=format(int(votes[0, 2]), "08x"))
         self._stop = bool(votes[:, 0].max())
+        if self._els is not None and votes[:, 4].max():
+            # every process sees the same departure column: the agreed
+            # change becomes due on all of them at THIS boundary (the
+            # loop's poll right after this tick picks it up)
+            self._els.on_vote(votes[:, 4], step)
         if self._logger is not None and len(votes) > 1:
             work = votes[:, 3]
             if int(work.max()) > 0:
@@ -1343,6 +1399,7 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
     rmon = resources.monitor_from_flags(FLAGS, model, opt,
                                         FLAGS.batch_size, n_chips)
     snt = _sentinel_for(FLAGS, sv, logger)
+    els = elastic.supervisor_from_flags(FLAGS)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
                                         eff=eff)
@@ -1387,9 +1444,14 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
                 meter.reset()
                 stimer.reset()  # compile stays out of the breakdown too
                 compile_done = True
+            # a due membership change pulls the next checkpoint boundary
+            # to THIS step (the standard-layout fetch below is the drain
+            # state the re-formed world restores)
+            due = els is not None and els.poll(step)
             boundary = (step % FLAGS.display_step == 0
                         or (eval_every and step % eval_every == 0)
-                        or sv.checkpointer.cadence_due())
+                        or sv.checkpointer.cadence_due()
+                        or due)
             if boundary:
                 # the standard-layout fetch blocks on the step's device
                 # work — the PP host loop's one device-wait site (there
@@ -1418,6 +1480,8 @@ def _train_pipeline(FLAGS, ds, model, opt, state, mode,
                 periodic_eval(host, step)
                 with _charged(eff, "ckpt"):
                     sv.maybe_checkpoint(host, step)
+                if due:
+                    els.maybe_resize(step)
         jax.block_until_ready(pp_state.params)
         host = fetch_state_pp(pp_state, model, k_stages=model_axis,
                               virtual_stages=vstages)
@@ -1502,6 +1566,7 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
     rmon = resources.monitor_from_flags(FLAGS, model, opt,
                                         FLAGS.batch_size, n_chips)
     snt = _sentinel_for(FLAGS, sv, logger)
+    els = elastic.supervisor_from_flags(FLAGS)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
                                         eff=eff)
@@ -1559,12 +1624,14 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
             # clean over `step % eval_every == 0` (chunks align to
             # display_step, not eval_step), so fire on the chunk that
             # crossed; periodic_eval's own crossing logic evaluates once
+            due = els is not None and els.poll(step)
             boundary = (step % FLAGS.display_step == 0
                         or (eval_every and
                             (step - length) // eval_every
                             != step // eval_every)
                         or sv.checkpointer.cadence_due()
-                        or step >= FLAGS.training_iter)
+                        or step >= FLAGS.training_iter
+                        or due)
             if boundary:
                 # the fetch blocks on the chunk's device work —
                 # attributed to the device column like the host PP loop
@@ -1592,6 +1659,8 @@ def _train_pipeline_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                 periodic_eval(host, step)
                 with _charged(eff, "ckpt"):
                     sv.maybe_checkpoint(host, step)
+                if due:
+                    els.maybe_resize(step)
         jax.block_until_ready(pp_state.params)
         host = fetch_state_pp(pp_state, model, k_stages=k_stages,
                               virtual_stages=vstages)
@@ -1726,6 +1795,7 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
     rmon = resources.monitor_from_flags(FLAGS, model, opt,
                                         FLAGS.batch_size, n_chips)
     snt = _sentinel_for(FLAGS, sv, logger)
+    els = elastic.supervisor_from_flags(FLAGS)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
                                         eff=eff)
@@ -1816,10 +1886,12 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
                     jax.profiler.stop_trace()
                     profiling = False
                     profile_done = True
+                due = els is not None and els.poll(step)
                 boundary = (step % FLAGS.display_step == 0
                             or (eval_every and step % eval_every == 0)
                             or sv.checkpointer.cadence_due()
-                            or step >= FLAGS.training_iter)
+                            or step >= FLAGS.training_iter
+                            or due)
                 if boundary:
                     with trace_span("boundary_fetch", step=step), \
                             telemetry.armed("zero_boundary_fetch",
@@ -1830,6 +1902,8 @@ def _train_zero(FLAGS, ds, model, opt, state, mode, accum, augment_fn,
                     periodic_eval(host, step)
                     with _charged(eff, "ckpt"):
                         sv.maybe_checkpoint(host, step)
+                    if due:
+                        els.maybe_resize(step)
             jax.block_until_ready(z_state.params)
         finally:
             if profiling:
@@ -1915,6 +1989,7 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
     rmon = resources.monitor_from_flags(FLAGS, model, opt,
                                         FLAGS.batch_size, n_chips)
     snt = _sentinel_for(FLAGS, sv, logger)
+    els = elastic.supervisor_from_flags(FLAGS)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
                                         eff=eff)
@@ -2004,12 +2079,14 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                 jax.profiler.stop_trace()
                 profiling = False
                 profile_done = True
+            due = els is not None and els.poll(step)
             boundary = (step % FLAGS.display_step == 0
                         or (eval_every and
                             (step - length) // eval_every
                             != step // eval_every)
                         or sv.checkpointer.cadence_due()
-                        or step >= FLAGS.training_iter)
+                        or step >= FLAGS.training_iter
+                        or due)
             if boundary:
                 with trace_span("boundary_fetch", step=step), \
                         telemetry.armed("zero_boundary_fetch", step=step), \
@@ -2019,6 +2096,8 @@ def _train_zero_device(FLAGS, ds, model, opt, state, mesh, n_chips,
                 periodic_eval(host, step)
                 with _charged(eff, "ckpt"):
                     sv.maybe_checkpoint(host, step)
+                if due:
+                    els.maybe_resize(step)
         jax.block_until_ready(z_state.params)
         if profiling:
             jax.profiler.stop_trace()
@@ -2140,6 +2219,7 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
     rmon = resources.monitor_from_flags(FLAGS, model, opt,
                                         FLAGS.batch_size, n_chips)
     snt = _sentinel_for(FLAGS, sv, logger)
+    els = elastic.supervisor_from_flags(FLAGS)
     last_display = {}
     periodic_eval = _periodic_test_eval(FLAGS, sv, model, ds, logger,
                                         eff=eff)
@@ -2148,7 +2228,8 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
     stimer = StepTimer()
 
     coord = (_HostCoordinator(sv, coord_steps_from_flags(FLAGS),
-                              stimer=stimer, logger=logger)
+                              stimer=stimer, logger=logger,
+                              elastic_sv=els)
              if jax.process_count() > 1 else None)
     should_stop = coord.should_stop if coord is not None else sv.should_stop
 
@@ -2244,6 +2325,11 @@ def _train_device_resident(FLAGS, ds, model, opt, state, mesh, n_chips,
             else:
                 with _charged(eff, "ckpt"):
                     sv.maybe_checkpoint(state, step)
+            if els is not None and els.poll(step):
+                # membership change due: the StateBox already holds this
+                # boundary's state — drain via the managed-exit save and
+                # re-form (raises ResizeRequired)
+                els.maybe_resize(step)
         jax.block_until_ready(state.params)
         if profiling:
             jax.profiler.stop_trace()

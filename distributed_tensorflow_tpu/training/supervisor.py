@@ -310,7 +310,16 @@ class Supervisor:
         """Context manager over a training run: restore-or-init on entry,
         final checkpoint + stop on exit (normal, error, or SIGTERM/SIGINT
         — the signal path requests a stop, the loop drains, and the final
-        save lands here)."""
+        save lands here).
+
+        An elastic ``ResizeRequired`` unwinding through here is a CLEAN
+        exit: every participant raises it at the same agreed boundary
+        (the vote invariant), and the final save below IS the drain
+        checkpoint the re-formed world restores from. The one exception
+        is ``lost_step`` (an immediate preemption — the capacity died
+        with the step): the state is dropped so NO save happens, and the
+        re-form falls back to the newest cadenced checkpoint or the
+        sentinel's adopted emergency snapshot."""
         state_box = _StateBox(*self.init_or_restore(init_state))
         restore_signals = (
             self._install_signal_handlers() if handle_signals else lambda: None
@@ -319,6 +328,24 @@ class Supervisor:
         try:
             yield state_box
             clean_exit = True
+        except Exception as e:
+            from distributed_tensorflow_tpu.training.elastic import (
+                Departed,
+                ResizeRequired,
+            )
+
+            if isinstance(e, ResizeRequired):
+                if e.lost_step:
+                    state_box.state = None  # lost with the capacity
+                else:
+                    clean_exit = True  # the final save is the drain
+            elif isinstance(e, Departed):
+                # the preempted process leaves at the AGREED boundary —
+                # a clean exit: it must vote clean in the exit agreement
+                # and join the final collective fetch, or cross-host-
+                # sharded survivors would skip the drain save
+                clean_exit = True
+            raise
         finally:
             restore_signals()
             abandoned = None  # set => raise after cleanup (clean exits)

@@ -327,11 +327,18 @@ class GoodputMeter:
 
     def scalars(self) -> dict:
         wall = time.perf_counter() - self._t0
+        # resize_s (r15): the elasticity supervisor's drain+reinit+
+        # restore downtime is a NAMED stall category — always present
+        # (0.0 when no membership change happened) so dashboards and
+        # fleet_report can chart it without schema sniffing
+        resize = round(self._by_kind.get("resize", 0.0), 4)
         if wall <= 0:
-            return {"goodput": 1.0, "goodput_lost_s": 0.0}
+            return {"goodput": 1.0, "goodput_lost_s": 0.0,
+                    "resize_s": resize}
         ratio = min(max((wall - self._lost) / wall, 0.0), 1.0)
         return {"goodput": round(ratio, 6),
-                "goodput_lost_s": round(self._lost, 4)}
+                "goodput_lost_s": round(self._lost, 4),
+                "resize_s": resize}
 
 
 class EfficiencyMeter:

@@ -75,11 +75,28 @@ INJECTION_POINTS: dict[str, str] = {
                     "the fallback-ladder restore of a newer checkpoint "
                     "(file modes corrupt that newest set) "
                     "[ctx: path, step]",
+    "preempt": "in the elasticity supervisor's boundary poll "
+               "(training/elastic.py) — models a spot/preemptible "
+               "capacity loss. mode=notice: advance warning, the run "
+               "drains to the next checkpoint boundary before the host "
+               "departs; mode=immediate: the capacity is gone NOW and "
+               "the in-flight step is lost (restore falls back to the "
+               "last checkpoint or the sentinel's emergency snapshot). "
+               "Keys: host=H (which world member departs; default the "
+               "highest-indexed), notice_s=S (the modeled grace "
+               "window, recorded in the membership_change span), "
+               "rejoin_steps=N (the departed host re-joins N steps "
+               "after the resize — the kill-and-re-add chaos shape) "
+               "[ctx: step]",
 }
 
 MODES = ("crash", "error", "refuse", "torn_file", "zero_file", "bitflip",
-         "delay")
+         "delay", "notice", "immediate")
 _FILE_MODES = ("torn_file", "zero_file", "bitflip")
+# preemption modes only make sense on the preempt point (and vice versa:
+# a file mode on preempt would ask for a path the poll site cannot name)
+_PREEMPT_MODES = ("notice", "immediate")
+_PREEMPT_KEYS = ("notice_s", "host", "rejoin_steps")
 
 FAULT_EXIT_CODE = 17  # the injected hard-crash exit status
 
@@ -87,6 +104,30 @@ FAULT_EXIT_CODE = 17  # the injected hard-crash exit status
 class InjectedFault(RuntimeError):
     """The error raised by mode=error/refuse — never raised by real code,
     so tests and harnesses can assert the failure was the injected one."""
+
+
+class Preempted(InjectedFault):
+    """Raised by the ``preempt`` point's notice/immediate modes: the
+    modeled spot-preemption signal. ONLY the elasticity supervisor's
+    boundary poll calls that point, and it catches this exception and
+    turns it into a planned membership change (training/elastic.py) —
+    an unhandled Preempted means no supervisor was armed, which is
+    itself the honest un-elastic behavior (the run dies like a real
+    unhandled preemption)."""
+
+    def __init__(self, desc: str, host: int | None = None,
+                 notice_s: float = 0.0, immediate: bool = False,
+                 rejoin_steps: int = 0, at_step: int | None = None):
+        super().__init__(desc)
+        self.host = host
+        self.notice_s = notice_s
+        self.immediate = immediate
+        self.rejoin_steps = rejoin_steps
+        # the originating rule's identity (host, at_step) lets the
+        # elasticity supervisor execute each configured departure at
+        # most once per RUN — loop re-entries re-arm the rules, so the
+        # fired counter alone cannot carry that guarantee
+        self.at_step = at_step
 
 
 class FaultSpecError(ValueError):
@@ -102,12 +143,17 @@ class FaultRule:
     after: int = 0
     times: int = 1  # 0 = unlimited
     delay: float = 1.0
+    # preempt-point payload (parse rejects these keys elsewhere)
+    host: int | None = None
+    notice_s: float = 0.0
+    rejoin_steps: int = 0
     # mutable runtime counters
     hits: int = field(default=0, compare=False)
     fired: int = field(default=0, compare=False)
 
 
-_INT_KEYS = ("at_step", "at_count", "after", "times")
+_INT_KEYS = ("at_step", "at_count", "after", "times", "host",
+             "rejoin_steps")
 
 
 def parse_fault_spec(spec: str) -> list[FaultRule]:
@@ -145,19 +191,56 @@ def parse_fault_spec(spec: str) -> list[FaultRule]:
                     raise FaultSpecError(
                         f"{key}={val!r} in rule {part!r}: expected an "
                         f"integer") from None
-            elif key == "delay":
+            elif key in ("delay", "notice_s"):
                 try:
-                    rule.delay = float(val)
+                    setattr(rule, key, float(val))
                 except ValueError:
                     raise FaultSpecError(
-                        f"delay={val!r} in rule {part!r}: expected "
+                        f"{key}={val!r} in rule {part!r}: expected "
                         f"seconds") from None
             else:
                 raise FaultSpecError(
                     f"unknown key {key!r} in rule {part!r}; keys: mode, "
-                    f"{', '.join(_INT_KEYS)}, delay")
+                    f"{', '.join(_INT_KEYS)}, delay, notice_s")
+        _check_preempt_rule(rule, part)
         rules.append(rule)
     return rules
+
+
+def _check_preempt_rule(rule: FaultRule, part: str) -> None:
+    """Cross-field consistency for the preempt point: the preemption
+    modes/keys belong to it and to nothing else, and a file mode on it
+    would ask for a path the poll site can never name."""
+    if rule.point == "preempt":
+        if rule.mode in _FILE_MODES:
+            raise FaultSpecError(
+                f"mode={rule.mode} in rule {part!r}: the preempt poll "
+                f"site names no file; preempt modes are "
+                f"{', '.join(_PREEMPT_MODES)} (or error/crash/delay)")
+        if rule.notice_s < 0:
+            raise FaultSpecError(
+                f"notice_s={rule.notice_s} in rule {part!r}: the "
+                f"preemption grace window must be >= 0 seconds")
+        if rule.rejoin_steps < 0:
+            raise FaultSpecError(
+                f"rejoin_steps={rule.rejoin_steps} in rule {part!r} "
+                f"must be >= 0 (0 = the host never re-joins)")
+        if rule.host is not None and rule.host < 0:
+            raise FaultSpecError(
+                f"host={rule.host} in rule {part!r} must be >= 0 (a "
+                f"world-member index)")
+        return
+    if rule.mode in _PREEMPT_MODES:
+        raise FaultSpecError(
+            f"mode={rule.mode} in rule {part!r} only applies to the "
+            f"preempt point (it is the spot-preemption signal)")
+    for key in _PREEMPT_KEYS:
+        default = FaultRule(point=rule.point)
+        if getattr(rule, key) != getattr(default, key):
+            raise FaultSpecError(
+                f"key {key!r} in rule {part!r} only applies to the "
+                f"preempt point (it parameterizes the membership "
+                f"change)")
 
 
 _LOCK = threading.Lock()
@@ -195,6 +278,29 @@ def active() -> bool:
     return bool(_RULES)
 
 
+def _ensure_env_rules() -> None:
+    """Lazily arm rules from DTT_FAULT_SPEC if no explicit configure ran
+    (the one-time env check fault_point performs, factored out so
+    ``armed_points`` sees env-armed rules too)."""
+    global _ENV_CHECKED
+    if _RULES or _ENV_CHECKED:
+        return
+    with _LOCK:
+        if not _ENV_CHECKED:
+            _ENV_CHECKED = True
+            spec = os.environ.get("DTT_FAULT_SPEC", "")
+            if spec:
+                _RULES[:] = parse_fault_spec(spec)
+
+
+def armed_points() -> set:
+    """The set of injection-point names with a configured rule (env-var
+    rules included) — how the elasticity supervisor auto-arms when a
+    ``preempt`` rule exists without an explicit ``--elastic``."""
+    _ensure_env_rules()
+    return {r.point for r in _RULES}
+
+
 def _corrupt_file(path: str, mode: str) -> None:
     size = os.path.getsize(path)
     if mode == "zero_file":
@@ -215,16 +321,8 @@ def fault_point(name: str, **ctx) -> None:
     """The injection site call. No-op unless a configured rule matches
     ``name`` and the ctx filters; then performs the rule's mode (which may
     not return: crash exits the process, error/refuse raises)."""
-    global _ENV_CHECKED
     if not _RULES:
-        if _ENV_CHECKED:
-            return
-        with _LOCK:
-            if not _ENV_CHECKED:
-                _ENV_CHECKED = True
-                spec = os.environ.get("DTT_FAULT_SPEC", "")
-                if spec:
-                    _RULES[:] = parse_fault_spec(spec)
+        _ensure_env_rules()
         if not _RULES:
             return
     for rule in _RULES:
@@ -259,6 +357,11 @@ def _fire(rule: FaultRule, name: str, ctx: dict) -> None:
     if rule.mode == "crash":
         print(f"{desc}: hard-exiting {FAULT_EXIT_CODE}", flush=True)
         os._exit(FAULT_EXIT_CODE)
+    if rule.mode in _PREEMPT_MODES:
+        raise Preempted(desc, host=rule.host, notice_s=rule.notice_s,
+                        immediate=(rule.mode == "immediate"),
+                        rejoin_steps=rule.rejoin_steps,
+                        at_step=rule.at_step)
     if rule.mode in ("error", "refuse"):
         raise InjectedFault(desc)
     if rule.mode == "delay":
@@ -286,12 +389,15 @@ def describe_points() -> str:
         lines.append(f"  {pname:<{width}}  {INJECTION_POINTS[pname]}")
     lines += [
         "",
-        f"modes: {', '.join(MODES)}",
-        "keys:  mode, at_step, at_count, after, times (0=unlimited), delay",
+        f"modes: {', '.join(MODES)} (notice/immediate: preempt only)",
+        "keys:  mode, at_step, at_count, after, times (0=unlimited), "
+        "delay, host, notice_s, rejoin_steps (last three: preempt only)",
         "examples:",
         "  --fault_spec ckpt_write:at_step=40:mode=crash",
         "  --fault_spec restore:mode=torn_file",
         "  --fault_spec init:mode=refuse:times=2",
+        "  --fault_spec preempt:at_step=60:mode=notice:notice_s=30:host=3",
+        "  --fault_spec preempt:mode=immediate:host=2:rejoin_steps=40",
         "  DTT_FAULT_SPEC=prefetch:at_count=3:mode=error  (env var form "
         "for subprocesses)",
     ]

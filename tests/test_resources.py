@@ -451,7 +451,7 @@ LOOP_VARIANTS = {
 STANDARD_SCALARS = (
     "images_per_sec",
     "step_host_wait_s", "step_dispatch_s", "step_device_s",
-    "mfu", "model_flops_per_sec", "goodput",
+    "mfu", "model_flops_per_sec", "goodput", "resize_s",
     "hbm_in_use_bytes", "hbm_peak_bytes", "hbm_headroom_pct",
     "compiles_total", "compile_time_s", "recompiles_total",
     "comm_bytes_per_step", "comm_exposed_bytes_per_step",

@@ -228,6 +228,14 @@ def analyze(paths: list[str],
                      if r.get("name") == "hbm_sample" and "peak" in r]
         comm = next((r for r in recs if r.get("name") == "comm_ledger"
                      and "comm_bytes_per_step" in r), None)
+        # elastic plane (r15): each completed resize drops a `resize`
+        # instant carrying its measured downtime (drain+reinit+restore)
+        # and a `membership_change` instant at the change itself — the
+        # per-host resize accounting comes from the same merged files
+        resizes = [float(r["resize_s"]) for r in recs
+                   if r.get("name") == "resize" and "resize_s" in r]
+        n_changes = sum(1 for r in recs
+                        if r.get("name") == "membership_change")
         hosts[host] = {
             "spans": len(recs),
             "steps": len(steps),
@@ -239,6 +247,8 @@ def analyze(paths: list[str],
             "hbm_peak_bytes": max(hbm_peaks) if hbm_peaks else None,
             "comm_bytes_per_step": (int(comm["comm_bytes_per_step"])
                                     if comm is not None else None),
+            "resize_s": round(sum(resizes), 4) if resizes else None,
+            "membership_changes": n_changes or None,
         }
     straggler = (max(excess, key=excess.get)
                  if excess and len(by_host) > 1 else None)
@@ -267,13 +277,15 @@ def print_report(report: dict, out=None) -> None:
           f"{report['steps_compared']} steps compared", file=out)
     print(f"{'host':<16} {'spans':>7} {'steps':>6} {'work_s':>10} "
           f"{'clock_off_s':>12} {'straggled':>9} {'hbm_peak':>9} "
-          f"{'comm/step':>10}", file=out)
+          f"{'comm/step':>10} {'resize_s':>9}", file=out)
     for host, h in report["hosts"].items():
+        rs = h.get("resize_s")
         print(f"{host:<16} {h['spans']:>7} {h['steps']:>6} "
               f"{h['work_s']:>10.3f} {h['clock_offset_s']:>12.6f} "
               f"{h['straggler_steps']:>9} "
               f"{_mb(h.get('hbm_peak_bytes')):>9} "
-              f"{_mb(h.get('comm_bytes_per_step')):>10}", file=out)
+              f"{_mb(h.get('comm_bytes_per_step')):>10} "
+              f"{(f'{rs:.2f}' if rs is not None else '-'):>9}", file=out)
     if report["steps_compared"]:
         print(f"step skew: p50={report['skew_p50_s'] * 1e3:.3f}ms "
               f"p90={report['skew_p90_s'] * 1e3:.3f}ms "
