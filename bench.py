@@ -1698,6 +1698,38 @@ def recovery_phase() -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def lint_phase() -> dict:
+    """dttlint drill (r16): run the AST invariant linter over the whole
+    walk set with the checked-in baseline. HOST-ONLY (pure ``ast``, no
+    jax, no chip), so the ``lint_*`` facts stay NON-NULL in EVERY
+    record including the degraded/outage one, per the bench contract —
+    PROGRESS tracks ``lint_baselined_total`` trending to zero (the
+    baseline can only shrink: stale suppressions fail the run)."""
+    try:
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools.dttlint import run_lint
+
+        t0 = time.perf_counter()
+        res = run_lint()
+        return {
+            "lint_findings_total": len(res.findings),
+            "lint_baselined_total": len(res.baselined),
+            "lint_stale_suppressions": len(res.stale),
+            "lint_rules": len(res.rules),
+            "lint_time_s": round(time.perf_counter() - t0, 3),
+        }
+    except Exception as e:  # never kill the record over the drill
+        return {"lint_findings_total": None,
+                "lint_baselined_total": None,
+                "lint_stale_suppressions": None,
+                "lint_rules": None,
+                "lint_time_s": None,
+                "lint_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def elastic_phase() -> dict:
     """Elastic-resize drill (r15): drive the detect -> drain -> adopt ->
     restore ladder end to end on a tiny host state — the REAL machinery
@@ -1951,6 +1983,9 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
     # r15: the elastic-resize drill is host-only like the recovery
     # drill — detect/adopt/restore facts stay non-null through outages
     out.update(elastic_phase())
+    # r16: the dttlint drill is pure ast — the static-invariant facts
+    # (findings/baseline trend) stay non-null through outages too
+    out.update(lint_phase())
     if partial:
         out.update(partial)
     return out
@@ -2071,6 +2106,10 @@ def _run_phases(out: dict):
     # r15: the elastic-resize drill (host-only; also runs in the
     # degraded record so the elastic facts are never null)
     out.update(elastic_phase())
+    # r16: dttlint over the whole tree — the suppression count is a
+    # tracked headline (trending to zero), and a nonzero finding count
+    # in a bench record means the tree shipped a new invariant break
+    out.update(lint_phase())
 
     print(json.dumps(out))
 

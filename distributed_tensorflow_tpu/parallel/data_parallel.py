@@ -160,3 +160,15 @@ def replicate_state(mesh, state: TrainState) -> TrainState:
                 f"(e.g. parallel.zero.fetch_state_zero) and replicate "
                 f"that.")
     return jax.device_put(state, replicated_sharding(mesh))
+
+
+def dp_comm_rows(grad_bytes: int, d: int) -> list[dict]:
+    """Static per-step collective wire bytes for plain replicated DP —
+    this module's ONE collective, the grad ``pmean`` (a ring all-reduce,
+    ~2|G| on the wire over the data axis). Delegates to the ZeRO level-0
+    row so the all-reduce convention has exactly one formula
+    (``parallel/zero.zero_comm_rows`` generalizes this pattern over the
+    sharding levels); ``utils/resources.comm_ledger`` composes it."""
+    from distributed_tensorflow_tpu.parallel.zero import zero_comm_rows
+
+    return zero_comm_rows(grad_bytes, 0, 0, d)

@@ -1249,3 +1249,34 @@ def run_worker(cluster, FLAGS) -> int:
     print("Optimization Finished!")
     logger.close()
     return 0
+
+
+def ps_comm_rows(param_bytes: int, grad_bytes: int, *,
+                 wire: str = "f32", mirror: bool = True) -> list[dict]:
+    """Static per-cycle wire bytes for the ps topology — the ledger row
+    builder living next to the transfers it prices (the r13 convention;
+    ``utils/resources.comm_ledger`` composes it for ``mode="ps"``).
+    Unlike the mesh modes these bytes ride TCP + the host<->chip link,
+    not ICI: a full pull/compute/push cycle moves |P| down and |G| up
+    per worker (halved by ``--ps_wire bf16``); ``--ps_mirror`` replaces
+    the pull with an on-chip update replay, so the pull row's bytes
+    drop to the resync cadence. (A multi-chip worker's local grad pmean
+    before the push is plain DP over its local mesh —
+    ``data_parallel.dp_comm_rows`` prices that row.)"""
+    scale = 0.5 if wire == "bf16" else 1.0
+    pull = int(param_bytes * scale)
+    push = int(grad_bytes * scale)
+    rows = [{
+        "collective": "pull(params, ps->worker)", "axis": "host",
+        "bytes": 0 if mirror else pull,
+        "exposed_bytes": 0 if mirror else pull,
+        "note": ("--ps_mirror replays updates on chip; full pulls only "
+                 "at the --ps_resync_steps cadence" if mirror else
+                 f"full parameter pull per cycle (|P|{' bf16' if scale < 1 else ''})"),
+    }, {
+        "collective": "push(grads, worker->ps)", "axis": "host",
+        "bytes": push, "exposed_bytes": push,
+        "note": f"gradient push per cycle (|G|"
+                f"{' bf16' if scale < 1 else ''})",
+    }]
+    return rows

@@ -442,7 +442,8 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
                 zero_level: int = 0, virtual_stages: int = 1,
                 microbatches: int = 0, pp_schedule: str = "auto",
                 zero_overlap: bool = False,
-                zero_bucket_mb: float = 4.0) -> dict:
+                zero_bucket_mb: float = 4.0,
+                ps_wire: str = "f32", ps_mirror: bool = True) -> dict:
     """STATIC per-step analytic of collective wire bytes for one
     parallel layout, composed from the parallel modules' own row
     builders (the formula lives next to the collective it prices).
@@ -476,10 +477,25 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
         rows += zero_comm_rows(grad_bytes, param_bytes, zero_level,
                                data_ways, overlap=bool(zero_overlap),
                                bucket_mb=float(zero_bucket_mb or 4.0))
+    elif mode == "ps":
+        from distributed_tensorflow_tpu.parallel.ps_emulation import (
+            ps_comm_rows,
+        )
+
+        # per-worker pull/push cycle over the HOST wire, not ICI
+        # (``ps_wire``/``ps_mirror`` mirror the --ps_wire/--ps_mirror
+        # flags; the pull row is 0 bytes under the mirror cycle)
+        rows += ps_comm_rows(param_bytes, grad_bytes,
+                             wire=ps_wire, mirror=ps_mirror)
     elif data_ways > 1:
         # every other multi-chip mode pays the plain DP grad all-reduce
-        # over its data rows
-        rows += zero_comm_rows(grad_bytes, param_bytes, 0, data_ways)
+        # over its data rows (dp_comm_rows delegates to the one
+        # all-reduce formula in zero_comm_rows level 0)
+        from distributed_tensorflow_tpu.parallel.data_parallel import (
+            dp_comm_rows,
+        )
+
+        rows += dp_comm_rows(grad_bytes, data_ways)
 
     is_tf = type(model).__name__ in ("MiniTransformer", "TransformerLM")
     seq = getattr(model, "seq_len", 0)

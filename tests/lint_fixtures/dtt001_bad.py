@@ -1,0 +1,17 @@
+"""DTT001 violating fixture: string-literal axis names (never imported,
+only parsed by dttlint)."""
+
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def step(x):
+    return lax.psum(x, "data")  # literal axis
+
+
+def scatter(x):
+    return lax.psum_scatter(x, axis_name="model", scatter_dimension=0)
+
+
+def specs(mesh, arr):
+    return P("data", None), Mesh(arr, ("data", "model"))

@@ -1,0 +1,18 @@
+"""DTT001 conforming fixture: mesh constants and forwarded parameters."""
+
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def step(x):
+    return lax.psum(x, DATA_AXIS)
+
+
+def fwd(x, axis_name):
+    return lax.psum(x, axis_name)  # forwarded parameter
+
+
+def specs(mesh, arr):
+    return P(DATA_AXIS, None), Mesh(arr, (DATA_AXIS, MODEL_AXIS))

@@ -1,0 +1,10 @@
+"""DTT002 violating fixture: a parallel/ module with a collective but
+no *_comm_rows pricing builder."""
+
+from jax import lax
+
+from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
+
+
+def ring(x, perm):
+    return lax.ppermute(x, MODEL_AXIS, perm)

@@ -1,0 +1,16 @@
+"""DTT002 conforming fixture: the collective ships with its ledger
+row builder."""
+
+from jax import lax
+
+from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
+
+
+def ring(x, perm):
+    return lax.ppermute(x, MODEL_AXIS, perm)
+
+
+def ring_comm_rows(act_bytes: int, hops: int) -> list:
+    return [{"collective": "ppermute(ring)", "axis": "model",
+             "bytes": act_bytes * hops, "exposed_bytes": act_bytes * hops,
+             "note": "fixture"}]

@@ -1,0 +1,9 @@
+"""DTT003 conforming fixture: the full loop-variant contract."""
+
+
+def _train_ok(FLAGS, ds, sv, logger, meter, stimer, eff, rmon, els):
+    _log_recovery(sv, logger, 0, eff)  # noqa: F821 — parsed, not run
+    for step in range(10):
+        logger.scalars(step,
+                       _display_scalars(meter, stimer, eff, rmon))  # noqa: F821
+        els.maybe_resize(step)

@@ -1,0 +1,12 @@
+"""DTT004 violating fixture: an unregistered fire site AND an orphaned
+registry entry."""
+
+INJECTION_POINTS = {
+    "known": "a point with a site",
+    "orphan": "registered but never fired",
+}
+
+
+def save(path):
+    fault_point("known", path=path)  # noqa: F821 — parsed, not run
+    fault_point("unknown_point")  # noqa: F821

@@ -1,0 +1,10 @@
+"""DTT004 conforming fixture: every fired point registered, every
+registered point fired."""
+
+INJECTION_POINTS = {
+    "known": "a point with a site",
+}
+
+
+def save(path):
+    fault_point("known", path=path)  # noqa: F821 — parsed, not run

@@ -1,0 +1,9 @@
+"""DTT005 violating fixture: an undocumented span name (rogue_span)
+plus the doc table's ghost_span with no site — drift both ways."""
+
+
+def run(step):
+    with trace_span("good_span", step=step):  # noqa: F821
+        pass
+    with trace_span("rogue_span", step=step):  # noqa: F821
+        pass

@@ -1,0 +1,11 @@
+"""DTT005 conforming fixture: literal, conditional-variable and
+parameterized span names, all in the table."""
+
+
+def run(step, zb, point, tracer):
+    with trace_span("good_span", step=step):  # noqa: F821
+        pass
+    name = "cond_a" if zb else "cond_b"
+    with trace_span(name, step=step):  # noqa: F821
+        pass
+    tracer.record_instant(f"fault:{point}", step=step)

@@ -1,0 +1,17 @@
+"""DTT006 violating fixture: a flag no registered validator reads."""
+
+
+def DEFINE_integer(name, default, help_str=""):
+    pass
+
+
+DEFINE_integer("checked", 1, "covered below")
+DEFINE_integer("unchecked", 2, "nobody validates this")
+
+
+def _validate(values):
+    if int(values.get("checked") or 0) < 0:
+        raise ValueError("--checked must be >= 0")
+
+
+FLAGS._register_validator(_validate)  # noqa: F821 — parsed, not run

@@ -1,0 +1,26 @@
+"""DTT006 conforming fixture: every flag read by a registered
+validator — one directly, one through a reader helper (the _require
+pattern)."""
+
+
+def DEFINE_integer(name, default, help_str=""):
+    pass
+
+
+DEFINE_integer("checked", 1, "covered directly")
+DEFINE_integer("helped", 2, "covered via the helper")
+
+
+def _require(values, name, check, what):
+    v = values.get(name)
+    if v is not None and not check(v):
+        raise ValueError(f"--{name}={v} {what}")
+
+
+def _validate(values):
+    if int(values.get("checked") or 0) < 0:
+        raise ValueError("--checked must be >= 0")
+    _require(values, "helped", lambda v: int(v) >= 1, "must be >= 1")
+
+
+FLAGS._register_validator(_validate)  # noqa: F821 — parsed, not run

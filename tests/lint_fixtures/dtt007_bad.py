@@ -1,0 +1,25 @@
+"""DTT007 violating fixture: host impurities inside traced bodies."""
+
+import time
+
+import jax
+import numpy as np
+from jax import lax
+
+
+def make_step(xs):
+    def body(carry, x):
+        if x:  # host branch on a traced value
+            carry = carry + 1
+        print("step")  # host I/O at trace time only
+        t = time.time()  # frozen at trace time
+        noise = np.random.rand()  # drawn once, baked into the program
+        return carry + t + noise, x
+
+    return lax.scan(body, 0, xs)
+
+
+@jax.jit
+def apply(a):
+    print(a)  # trace-time only
+    return a * 2

@@ -1,0 +1,27 @@
+"""DTT007 conforming fixture: structure checks, static-arg dispatch
+and jnp-native control flow are all legal in traced bodies."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def make_step(xs, augment_fn=None):
+    def body(carry, x):
+        if augment_fn is not None:  # closure structure, not a value
+            x = augment_fn(x)
+        if x.shape[0] > 1:  # static shape
+            x = x[:1]
+        carry = carry + jnp.where(x[0] > 0, 1, 0)  # traced branch, in-program
+        return carry, x
+
+    return lax.scan(body, 0, xs)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def apply(a, interpret=False):
+    if interpret:  # static arg: config dispatch, re-traced per value
+        return a
+    return a * 2

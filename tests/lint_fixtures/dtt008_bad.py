@@ -1,0 +1,11 @@
+"""DTT008 violating fixture: a donated argument read after the
+donating call."""
+
+import jax
+
+
+def run(fn, state, batch, other):
+    step = jax.jit(fn, donate_argnums=(0,))
+    state, m = step(state, batch)  # fine: donor rebound by the call
+    loss = step(other, batch)  # donates `other`...
+    return other.sum() + loss  # ...then reads the dead buffer

@@ -1,0 +1,11 @@
+"""DTT008 conforming fixture: donors are always rebound (or never
+read again)."""
+
+import jax
+
+
+def run(fn, state, batch, other):
+    step = jax.jit(fn, donate_argnums=(0,))
+    state, m = step(state, batch)
+    other = step(other, batch)  # donor rebound
+    return other, state, m

@@ -321,6 +321,13 @@ def test_degraded_record_keeps_schedule_facts_non_null():
     assert rec["pp_virtual_stages"] == 2
     # 2-way fallback config: K=2, M=2, V=2 -> 4/5
     assert rec["pp_useful_tick_fraction"] == 0.8
+    # r16: the static-analysis facts ride the degraded record too
+    # (dttlint is pure ast, no backend at all) — asserted here instead
+    # of paying a second full degraded_record build
+    assert rec["lint_findings_total"] == 0
+    assert rec["lint_rules"] == 8
+    assert rec["lint_baselined_total"] is not None
+    assert rec["lint_time_s"] is not None
 
 
 def test_pp_skip_record_carries_schedule_facts():
@@ -466,3 +473,19 @@ def test_overlap_phase_skips_on_one_chip(ds):
 # (the degraded-record assertions for the overlap keys ride the
 # existing test_degraded_record_keeps_zero_facts_non_null record build
 # — one degraded-record construction, not two)
+
+
+def test_lint_phase_runs_clean_and_fast():
+    """r16: the dttlint drill — zero non-baselined findings with the
+    checked-in baseline, all eight rules, inside the <10s acceptance
+    budget (pure ast, no chip)."""
+    out = bench.lint_phase()
+    assert out["lint_findings_total"] == 0, out
+    assert out["lint_stale_suppressions"] == 0
+    assert out["lint_rules"] == 8
+    assert out["lint_baselined_total"] >= 0
+    assert out["lint_time_s"] < 10.0
+    assert "lint_error" not in out
+    # the degraded-record ride-along is asserted in
+    # test_degraded_record_keeps_schedule_facts_non_null (one shared
+    # degraded_record build instead of two)

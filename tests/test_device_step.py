@@ -73,8 +73,12 @@ def test_device_step_matches_host_step_on_same_batch(data):
                                rtol=1e-5)
     for a, b in zip(jax.tree.leaves(new_dev.params),
                     jax.tree.leaves(new_host.params)):
+        # atol 2e-5: XLA fuses the on-device gather+step differently
+        # from the host-fed step, and one element in 51200 lands ~7e-6
+        # off on this container's CPU backend — same math, different
+        # fusion order
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-6)
+                                   rtol=1e-5, atol=2e-5)
 
 
 def test_deterministic_per_seed(data):

@@ -78,3 +78,42 @@ def test_resolve_mode_auto():
     flags.FLAGS._reset()
     flags.FLAGS._parse(["--mode=local", "--ps_hosts=a:1"])
     assert resolve_mode(flags.FLAGS) == "local"
+
+
+# ---- r16 (dttlint DTT006): the parse-time validator sweep ----------------
+
+
+@pytest.mark.parametrize("argv,needle", [
+    (["--training_iter=0"], "training_iter"),
+    (["--learning_rate=0"], "learning_rate"),
+    (["--display_step=0"], "display_step"),
+    (["--keep_prob=0"], "keep_prob"),
+    (["--keep_prob=1.5"], "keep_prob"),
+    (["--max_to_keep=0"], "max_to_keep"),
+    (["--device_chunk=0"], "device_chunk"),
+    (["--accum_steps=0"], "accum_steps"),
+    (["--coord_steps=0"], "coord_steps"),
+    (["--mode=turbo"], "--mode"),
+    (["--model=gpt5"], "--model="),
+    (["--dataset=imagenet"], "--dataset"),
+    (["--optimizer=lion"], "--optimizer"),
+    (["--lr_schedule=step"], "--lr_schedule"),
+    (["--prng=xorshift"], "--prng"),
+    (["--ps_wire=fp8"], "--ps_wire"),
+    (["--seq_len=1"], "seq_len"),
+    (["--moe_capacity=0"], "moe_capacity"),
+    (["--serve_port=70000"], "serve_port"),
+    (["--serve_temperature=-1"], "serve_temperature"),
+])
+def test_core_flag_validators_reject_at_parse_time(argv, needle):
+    """The r16 sweep (dttlint DTT006): bad values surface at the
+    command line with the flag NAMED — not mid-run."""
+    with pytest.raises(ValueError, match=needle):
+        flags.FLAGS._parse(argv)
+
+
+def test_core_flag_validators_accept_defaults_and_known_names():
+    flags.FLAGS._parse(["--model=lm", "--dataset=lm", "--optimizer=adam",
+                        "--lr_schedule=cosine", "--prng=rbg",
+                        "--ps_wire=bf16", "--mode=sync"])
+    assert flags.FLAGS.model == "lm"

@@ -1,0 +1,132 @@
+"""CLI: ``python -m tools.dttlint [--json] [--baseline PATH] [--fix]``.
+
+Exit status is the tier-1 contract: 0 when the tree has no
+non-baselined findings and no stale suppressions, 1 otherwise — so the
+command slots directly into the verify pipeline next to pytest.
+
+``--fix`` applies DTT001's mechanical rewrite: string-literal axis
+names ("data"/"model") in collective / PartitionSpec / Mesh calls
+become the ``mesh.DATA_AXIS``/``MODEL_AXIS`` constants, with the import
+added when missing. Only that rule fixes mechanically — every other
+finding needs a human (that's why they're rules).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# tools/ convention: runnable as a script too
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.dttlint import (  # noqa: E402
+    DEFAULT_BASELINE,
+    REPO_ROOT,
+    run_lint,
+)
+
+_MESH_IMPORT = ("from distributed_tensorflow_tpu.parallel.mesh import "
+                "DATA_AXIS, MODEL_AXIS\n")
+_AXIS_CONSTANTS = {"data": "DATA_AXIS", "model": "MODEL_AXIS"}
+
+
+def apply_dtt001_fixes(findings, root: str) -> int:
+    """Rewrite "data"/"model" axis literals to the mesh constants.
+    Returns the number of edits. Multi-edit files rewrite bottom-up so
+    earlier column offsets stay valid."""
+    by_file: dict[str, list] = {}
+    for f in findings:
+        if f.rule == "DTT001" and f.fix and \
+                f.fix["literal"] in _AXIS_CONSTANTS:
+            by_file.setdefault(f.path, []).append(f.fix)
+    edits = 0
+    for rel, fixes in by_file.items():
+        path = os.path.join(root, rel)
+        lines = open(path, encoding="utf-8").read().splitlines(
+            keepends=True)
+        used = set()
+        for fix in sorted(fixes, key=lambda x: (x["lineno"], x["col"]),
+                          reverse=True):
+            i = fix["lineno"] - 1
+            line = lines[i]
+            const = _AXIS_CONSTANTS[fix["literal"]]
+            used.add(const)
+            lines[i] = line[:fix["col"]] + const + line[fix["end_col"]:]
+            edits += 1
+        src = "".join(lines)
+        import re as _re
+
+        # every constant the rewrite introduced must be BOUND under its
+        # bare name — an aliased import (DATA_AXIS as _DA) does not count
+        bound = all(_re.search(
+            rf"^\s*(from .+ import .*\b{c}\b(?!\s+as\s)|{c}\s*=)",
+            src, _re.M) for c in used)
+        if not bound:
+            # add the constants import after the last top-level import
+            import ast as _ast
+
+            tree = _ast.parse(src)
+            last_import = 0
+            for node in tree.body:
+                if isinstance(node, (_ast.Import, _ast.ImportFrom)):
+                    last_import = node.end_lineno or node.lineno
+            if last_import == 0 and tree.body and \
+                    isinstance(tree.body[0], _ast.Expr) and \
+                    isinstance(tree.body[0].value, _ast.Constant) and \
+                    isinstance(tree.body[0].value.value, str):
+                # no imports: keep the module docstring first
+                last_import = tree.body[0].end_lineno or 0
+            lines = src.splitlines(keepends=True)
+            lines.insert(last_import, _MESH_IMPORT)
+            src = "".join(lines)
+        open(path, "w", encoding="utf-8").write(src)
+    return edits
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dttlint",
+        description="dttlint — the repo's AST invariant linter "
+                    "(rules DTT001-DTT008; see docs/ARCHITECTURE.md "
+                    "'Static analysis')")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON object")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression file (default: the checked-in "
+                         "tools/dttlint/baseline.json)")
+    ap.add_argument("--fix", action="store_true",
+                    help="apply DTT001's mechanical axis-constant "
+                         "rewrite, then re-lint")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help=argparse.SUPPRESS)  # fixture/test hook
+    args = ap.parse_args(argv)
+
+    result = run_lint(args.root, args.baseline)
+    if args.fix:
+        n = apply_dtt001_fixes(result.findings, args.root)
+        if n:
+            print(f"dttlint --fix: rewrote {n} axis literal(s) to mesh "
+                  f"constants", file=sys.stderr)
+            result = run_lint(args.root, args.baseline)
+
+    if args.json:
+        print(json.dumps(result.to_json()))
+    else:
+        for f in result.findings:
+            print(f.format())
+        for key in result.stale:
+            print(f"{args.baseline}: STALE suppression {key} — the "
+                  f"finding no longer exists; delete the entry (the "
+                  f"baseline only shrinks)")
+        print(f"dttlint: {len(result.findings)} finding(s), "
+              f"{len(result.baselined)} baselined, "
+              f"{len(result.stale)} stale suppression(s) across "
+              f"{len(result.rules)} rules")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

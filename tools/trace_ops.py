@@ -43,6 +43,13 @@ ppermutes, TP/EP activation psums, SP ring hops — wire bytes per step,
 per mode, no chip. The --mem/--flops printers' third sibling: memory,
 compute, and now the wire.
 
+The static-analysis sibling of this whole printer family is
+``python -m tools.dttlint``: where --schedule/--mem/--flops/--comm
+PRINT the tree's static facts, dttlint ENFORCES its static invariants
+(collective axis constants, comm-ledger coverage, the loop scalar
+contract, fault/span/flag registries, trace purity, donation safety —
+rules DTT001-DTT008, docs/ARCHITECTURE.md "Static analysis").
+
 Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
        python tools/trace_ops.py --schedule K M [V] [gpipe|interleaved|zb]
        python tools/trace_ops.py --faults
@@ -50,6 +57,7 @@ Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
        python tools/trace_ops.py --flops MODEL [BATCH]
        python tools/trace_ops.py --comm MODEL D [--model_axis K] [--batch B]
                                  [--zero_overlap] [--bucket_mb N]
+       python -m tools.dttlint [--json] [--baseline PATH] [--fix]
 """
 
 from __future__ import annotations
@@ -284,7 +292,13 @@ def print_comm(model_name: str, d: int, model_axis: int = 2,
     is_tf = model_name in ("lm",)
     modes = [("dp", dict(data_ways=d)),
              ("zero1", dict(data_ways=d, zero_level=1)),
-             ("zero3", dict(data_ways=d, zero_level=3))]
+             ("zero3", dict(data_ways=d, zero_level=3)),
+             # the reference topology: per-worker pull/push over the
+             # HOST wire (parallel/ps_emulation.ps_comm_rows) — both
+             # cycle shapes, since --ps_mirror zeroes the pull row
+             ("ps", dict(data_ways=d)),
+             ("ps-full", dict(data_ways=d, ps_mirror=False,
+                              ps_wire="bf16"))]
     if is_tf and d >= model_axis:
         dw = max(1, d // model_axis)
         modes += [("pp", dict(data_ways=dw, model_axis=model_axis)),
@@ -303,6 +317,9 @@ def print_comm(model_name: str, d: int, model_axis: int = 2,
         if mode == "pp-zb":
             mode, kw["pp_schedule"] = "pp", "zb"
             label = "pp (zb)"
+        elif mode == "ps-full":
+            mode = "ps"
+            label = "ps (full pulls, bf16 wire)"
         else:
             label = mode
         if mode.startswith("zero") and zero_overlap:
