@@ -202,16 +202,24 @@ def make_ep_eval_step(model, mesh):
     return eval_step
 
 
-def ep_comm_rows(act_bytes: int, n_moe_layers: int) -> list[dict]:
+def ep_comm_rows(act_bytes: int, n_moe_layers: int,
+                 rep_grad_bytes: int = 0) -> list[dict]:
     """Static per-step combine bytes for expert parallelism — the comm
     ledger's EP rows. Every device routes identically and computes its
     own experts' tokens; ONE psum per MoE layer combines the partial
     outputs (~2|A| on the wire per the all-reduce convention), and the
-    backward psums the cotangent the same way."""
+    backward psums the cotangent the same way (psum's transpose IS a
+    psum — the P-scaling trap the 1/P loss seed exists for).
+
+    ``rep_grad_bytes`` prices the step's third model-axis collective:
+    the REPLICATED leaves' (router/attention/embeddings/head) gradient
+    partials — each device holds 1/P of its copy's share — total under
+    one psum over the expert axis (~2x bytes). Unpriced before r18;
+    ``tools/dttcheck`` proved the gap against the lowered jaxpr."""
     if n_moe_layers <= 0:
         return []
     per_pass = 2 * act_bytes * n_moe_layers
-    return [
+    rows = [
         {"collective": "psum(expert combine, forward)", "axis": "model",
          "bytes": per_pass,
          "note": f"{n_moe_layers} MoE layers x ~2|A| combine"},
@@ -219,3 +227,11 @@ def ep_comm_rows(act_bytes: int, n_moe_layers: int) -> list[dict]:
          "bytes": per_pass,
          "note": "the combine's transpose redistributes cotangents"},
     ]
+    if rep_grad_bytes > 0:
+        rows.append({
+            "collective": "all_reduce(replicated-leaf grads)",
+            "axis": "model", "bytes": 2 * rep_grad_bytes,
+            "note": "non-expert leaves' per-device partials total "
+                    "under one psum over the expert axis (~2x, "
+                    "all-reduce convention)"})
+    return rows

@@ -761,14 +761,24 @@ def stage_batch_pp(mesh, batch):
 
 def pp_comm_rows(act_bytes_per_microbatch: int, k_stages: int,
                  microbatches: int, virtual_stages: int = 1,
-                 schedule: str = "auto") -> list[dict]:
+                 schedule: str = "auto",
+                 rep_grad_bytes: int = 0) -> list[dict]:
     """Static per-step boundary-transfer bytes for the stage ring — the
-    comm ledger's PP rows. Each microbatch's activation ppermutes
-    through ``K*V - 1`` boundary hops forward (the interleaved schedule
-    makes V shorter trips that add up to the same block sequence, plus
-    the V-1 wrap-around hops between groups), and the backward routes
-    the cotangent through the same hops in reverse. Tiny schedule
-    control traffic and the final metrics pmean are ignored.
+    comm ledger's PP rows, TICK-exact: the compiled step executes one
+    ``ppermute`` of a full activation slot on EVERY tick of the static
+    schedule (SPMD — masked bubble ticks move their zero payloads over
+    the wire like any other; the pre-r18 ledger priced only the
+    ``M*(K*V-1)`` useful hops and ``tools/dttcheck`` proved the
+    undercount against the lowered jaxpr). Forward runs ``num_ticks``
+    ring hops; the backward (AD transpose, or zb's explicit cotangent
+    ring — which permutes every tick of the SAME combined table) runs
+    the same count. Tiny schedule control traffic and the metrics
+    psums are control-plane (dttcheck's scalar exemption).
+
+    ``rep_grad_bytes`` prices the OTHER model-axis collective the step
+    runs: the replicated leaves' (tok/pos/ln_f/head) gradient partials
+    total under one psum over the stage axis (~2x bytes, all-reduce
+    convention) — unpriced before r18.
 
     ``exposed_bytes`` per row is the analytic on-critical-path share:
     under gpipe/interleaved every hop sits on the tick boundary (the
@@ -776,19 +786,37 @@ def pp_comm_rows(act_bytes_per_microbatch: int, k_stages: int,
     under zb the cotangent hops land in a stash and their consumers
     (B/W ticks) have slack from the deferred-W schedule, so the
     backward ring prices as overlapped (exposed 0)."""
+    if k_stages * max(1, virtual_stages) < 2:
+        return []  # a 1-stage "ring" has no boundary and no stage axis
     sched = normalize_pp_schedule(schedule, virtual_stages)
-    hops = max(0, k_stages * max(1, virtual_stages) - 1)
-    fwd = microbatches * hops * act_bytes_per_microbatch
-    bwd_note = ("the transpose routes the same bytes in reverse"
-                if sched != "zb" else
-                "zb: cotangents stash on arrival; deferred-W slack "
-                "hides the hop off the critical path")
-    return [
+    if sched == "zb":
+        ticks = build_zb_schedule(k_stages, microbatches,
+                                  max(1, virtual_stages)).num_ticks
+    else:
+        ticks = build_pp_schedule(k_stages, microbatches,
+                                  max(1, virtual_stages)).num_ticks
+    fwd = ticks * act_bytes_per_microbatch
+    bwd_note = ("the transpose ring permutes every backward tick "
+                "in reverse" if sched != "zb" else
+                "zb: the combined F/B/W table's cotangent ring fires "
+                "every tick; stash-on-arrival + deferred-W slack hide "
+                "it off the critical path")
+    rows = [
         {"collective": "ppermute(activations, forward)", "axis": "model",
          "bytes": fwd, "exposed_bytes": fwd,
-         "note": f"{microbatches} microbatches x {hops} boundary hops "
-                 f"({sched})"},
+         "note": f"{ticks} schedule ticks x 1 activation slot "
+                 f"({sched}; bubble ticks ride the wire too — "
+                 f"dttcheck-proven)"},
         {"collective": "ppermute(cotangents, backward)", "axis": "model",
          "bytes": fwd, "exposed_bytes": 0 if sched == "zb" else fwd,
          "note": bwd_note},
     ]
+    if rep_grad_bytes > 0:
+        rows.append({
+            "collective": "all_reduce(replicated-leaf grads)",
+            "axis": "model", "bytes": 2 * rep_grad_bytes,
+            "exposed_bytes": 2 * rep_grad_bytes,
+            "note": "tok/pos/ln_f/head partials (nonzero on the stages "
+                    "that use them) total under one psum over the "
+                    "stage axis (~2x, all-reduce convention)"})
+    return rows

@@ -278,22 +278,44 @@ def make_sp_eval_step(model, mesh, per_token_targets: bool = False):
 
 
 def sp_comm_rows(kv_block_bytes: int, ways: int,
-                 n_attn_layers: int) -> list[dict]:
-    """Static per-step ring-attention bytes — the comm ledger's SP rows.
-    Each attention layer rotates every device's k AND v token blocks
-    ``ways - 1`` hops around the ring forward; the backward replays the
-    ring (recompute) and additionally routes dk/dv back, so it moves
-    about twice the forward's bytes — an estimate by design (online-
-    softmax statistics are negligible next to the blocks)."""
+                 n_attn_layers: int,
+                 grad_bytes: int = 0) -> list[dict]:
+    """Static per-step ring-attention bytes — the comm ledger's SP
+    rows, hop-exact against ``ops/attention``'s lowered rings
+    (machine-proven by ``tools/dttcheck``, r18). Forward: each layer's
+    scan runs ``ways - 1`` prefetch iterations of 2 ppermutes (k and
+    v; the last block is consumed outside the scan, no trailing hop).
+    Backward (the custom flash VJP): ``ways`` iterations of 4
+    ppermutes — the k/v replay ring PLUS the dk/dv accumulators riding
+    home with their blocks (attend-then-rotate, one extra hop, which
+    is exactly what delivers each block's gradient to its owner). The
+    pre-r18 row approximated backward as 2x forward, undercounting by
+    4 blocks per layer; online-softmax statistics stay local (no
+    collective — the tracer confirms).
+
+    ``grad_bytes`` prices the step's other sequence-axis collective:
+    the uniform grad pmean over the token axis (every leaf replicated
+    — see the module docstring's two derivations), ~2|G| on the wire.
+    Unpriced before r18."""
     if ways < 2 or n_attn_layers <= 0:
         return []
     fwd = n_attn_layers * (ways - 1) * 2 * kv_block_bytes
-    return [
+    bwd = n_attn_layers * ways * 4 * kv_block_bytes
+    rows = [
         {"collective": "ppermute(k/v ring, forward)", "axis": "model",
          "bytes": fwd,
-         "note": f"{n_attn_layers} layers x {ways - 1} hops x (k+v) "
-                 f"blocks"},
+         "note": f"{n_attn_layers} layers x {ways - 1} scan hops x "
+                 f"(k+v) blocks"},
         {"collective": "ppermute(k/v ring + dk/dv, backward)",
-         "axis": "model", "bytes": 2 * fwd,
-         "note": "ring replay plus gradient blocks (~2x forward)"},
+         "axis": "model", "bytes": bwd,
+         "note": f"{n_attn_layers} layers x {ways} hops x "
+                 f"(k+v+dk+dv) blocks (flash-VJP replay ring)"},
     ]
+    if grad_bytes > 0:
+        rows.append({
+            "collective": "all_reduce(grads, sequence axis)",
+            "axis": "model", "bytes": 2 * grad_bytes,
+            "note": "the ONE uniform pmean over the token axis (exact "
+                    "for both loss families — module docstring), "
+                    "~2|G| all-reduce convention"})
+    return rows

@@ -245,23 +245,32 @@ def stage_batch_tp(mesh: Mesh, batch):
     return shard_batch(mesh, batch)
 
 
-def tp_comm_rows(act_bytes: int, n_boundaries: int) -> list[dict]:
+def tp_comm_rows(fwd_act_bytes: int, bwd_act_bytes: int) -> list[dict]:
     """Static per-step activation all-reduce bytes for the Megatron
-    split — the comm ledger's TP rows. Each row-split output boundary
-    psums one activation tensor forward (~2|A| on the wire, the
-    all-reduce convention) and its cotangent backward; XLA inserts the
-    collectives from the GSPMD layout, so this is the analytic twin of
-    what the partitioner schedules. ``n_boundaries`` is the count of
-    sync points per forward (transformer: attention-out + MLP-down per
-    block; the CNN FC stack: its one column->row boundary)."""
-    if n_boundaries <= 0:
-        return []
-    per_pass = 2 * act_bytes * n_boundaries
-    return [
-        {"collective": "all_reduce(activations, forward)", "axis": "model",
-         "bytes": per_pass,
-         "note": f"{n_boundaries} row-split boundaries x ~2|A|"},
-        {"collective": "all_reduce(cotangents, backward)", "axis": "model",
-         "bytes": per_pass,
-         "note": "the column-split inputs psum the backward pass"},
-    ]
+    split — the comm ledger's TP rows, priced against what the GSPMD
+    partitioner ACTUALLY inserts (machine-proven for the CNN by
+    ``tools/dttcheck`` r18 from the compiled SPMD HLO). The two
+    payloads differ because the two sync points sit at different
+    widths: forward psums the ROW-SPLIT matmul's partial OUTPUTS
+    (``fwd_act_bytes`` — for the CNN FC stack that is (B, num_classes),
+    NOT the hidden activations the pre-r18 row priced, a ~100x
+    overcount at the flagship shapes); backward psums the cotangent at
+    the COLUMN-SPLIT input (``bwd_act_bytes`` — (B, fc_in) for the
+    CNN). Transformer blocks are symmetric: both boundaries psum a
+    (B, S, d_model) tensor per block, attention-out + MLP-down.
+    All-reduce convention ~2x; callers pass the summed per-pass
+    payload."""
+    rows = []
+    if fwd_act_bytes > 0:
+        rows.append({
+            "collective": "all_reduce(activations, forward)",
+            "axis": "model", "bytes": 2 * fwd_act_bytes,
+            "note": "row-split boundaries psum their partial outputs "
+                    "(~2x, GSPMD-inserted)"})
+    if bwd_act_bytes > 0:
+        rows.append({
+            "collective": "all_reduce(cotangents, backward)",
+            "axis": "model", "bytes": 2 * bwd_act_bytes,
+            "note": "the column-split inputs psum the backward "
+                    "cotangent (~2x, GSPMD-inserted)"})
+    return rows

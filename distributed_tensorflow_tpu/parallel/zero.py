@@ -354,8 +354,11 @@ def _zero_step_core(model, optimizer, mesh, level, keep_prob,
     - at level 3 the params materialize from ONE bucketed all_gather
       reused by forward AND backward (grads are taken w.r.t. the full
       params and explicitly reduce-scattered — bitwise equal to the
-      serial path's remat'd gather transpose, pinned), cutting the
-      wire from |G|+2|P| to |G|+|P|; after the update the NEXT step's
+      serial path's remat'd gather transpose, pinned); the wire stays
+      |G|+|P| like the serial path's (whose checkpointed gather's
+      output is itself the saved residual — dttcheck-proven, r18),
+      but the gather leaves the critical path: after the update the
+      NEXT step's
       gather issues immediately (``next_full``), so a chunked caller
       carrying it double-buffers the gather behind the step epilogue
       and the next step's on-device sampling — the prefetch window.
@@ -635,11 +638,18 @@ def zero_comm_rows(grad_bytes: int, param_bytes: int, level: int,
     on the step's critical path. Serial rows expose everything.
     ``overlap=True`` prices the ``--zero_overlap`` pattern: a bucketed
     reduce-scatter exposes only its LAST bucket (earlier buckets issue
-    while backward still produces later grads), a prefetched level-3
-    gather exposes nothing (it issued right after the previous update,
-    hidden behind the epilogue + next-step sampling), and the level-3
-    backward re-gather row DISAPPEARS — the prefetched full params are
-    reused, cutting the wire from |G|+2|P| to |G|+|P|."""
+    while backward still produces later grads) and the level-3 gather
+    is prefetched (it issued right after the previous update, hidden
+    behind the epilogue + next-step sampling — exposed 0).
+
+    Level-3 wire volume is |G| + |P| in BOTH schedules — machine-proven
+    by ``tools/dttcheck`` (r18) against the lowered jaxpr: the serial
+    path's ``jax.checkpoint`` wraps only the gather, whose OUTPUT is
+    itself the saved residual the backward consumes, so no re-gather
+    ever reaches the wire (the pre-r18 ledger priced a phantom
+    backward-remat |P| here). What overlap changes is the SCHEDULE —
+    bucketing and the one-step prefetch — i.e. the exposed column, not
+    the volume."""
     if d < 2:
         return []
     if level == 0:
@@ -676,22 +686,19 @@ def zero_comm_rows(grad_bytes: int, param_bytes: int, level: int,
             "collective": "all_gather(params, prefetched)",
             "axis": "data", "bytes": param_bytes, "exposed_bytes": 0,
             "note": "issued right after the previous update and reused "
-                    "by forward AND backward — the remat re-gather's "
-                    "|P| never hits the wire"})
-    else:  # level 3: params live sharded, re-gathered fwd + bwd (remat)
+                    "by forward AND backward — off the critical path"})
+    else:  # level 3 serial: params live sharded, ONE gather per step
         rows[0]["collective"] = "reduce_scatter(grad transpose)"
         rows[0]["note"] = ("the all_gather's transpose routes grad "
                            "contributions to the owning rank (|G|)")
         rows.append({"collective": "all_gather(params, forward)",
                      "axis": "data", "bytes": param_bytes,
                      "exposed_bytes": param_bytes,
-                     "note": "sharded params materialize for the "
-                             "forward (|P|)"})
-        rows.append({"collective": "all_gather(params, backward remat)",
-                     "axis": "data", "bytes": param_bytes,
-                     "exposed_bytes": param_bytes,
-                     "note": "jax.checkpoint re-gathers instead of "
-                             "keeping a full copy (|P|)"})
+                     "note": "sharded params materialize once per step "
+                             "(|P|); the checkpointed gather's output "
+                             "is the saved residual, so the backward "
+                             "re-uses it — no re-gather on the wire "
+                             "(dttcheck-proven, r18)"})
     return rows
 
 

@@ -236,6 +236,14 @@ def _abstract_state(model, optimizer):
     return variables, None
 
 
+def _path_keys(path) -> tuple:
+    """KeyPath -> tuple of dict keys / sequence indices — the ONE
+    tree-path identity the divisor tables and the tp split-table
+    lookups key by (the tuple sibling of ``utils.pytree.path_key``)."""
+    return tuple(getattr(p, "key", getattr(p, "idx", None))
+                 for p in path)
+
+
 def _param_divisor_fn(mode: str, data_ways: int, model_axis: int,
                       zero_level: int, abstract_params):
     """(path, leaf) -> divisor: each mode's own sharding rule, spec-
@@ -250,9 +258,7 @@ def _param_divisor_fn(mode: str, data_ways: int, model_axis: int,
         # whatever V — interleaving permutes, it doesn't change the
         # per-device share); embed/head/norm replicate
         def div(path, leaf):
-            keys = tuple(getattr(p, "key", getattr(p, "idx", None))
-                         for p in path)
-            return model_axis if "blocks" in keys else 1
+            return model_axis if "blocks" in _path_keys(path) else 1
 
         return div
     if mode == "tp":
@@ -264,15 +270,12 @@ def _param_divisor_fn(mode: str, data_ways: int, model_axis: int,
         )
 
         specs = tp_param_specs(abstract_params)
-        flat = {tuple(getattr(p, "key", getattr(p, "idx", None))
-                      for p in path): spec
+        flat = {_path_keys(path): spec
                 for path, spec in jax.tree_util.tree_flatten_with_path(
                     specs, is_leaf=lambda x: isinstance(x, P))[0]}
 
         def div(path, leaf):
-            keys = tuple(getattr(p, "key", getattr(p, "idx", None))
-                         for p in path)
-            spec = flat.get(keys)
+            spec = flat.get(_path_keys(path))
             return (model_axis if spec is not None
                     and any(ax == MODEL_AXIS for ax in spec) else 1)
 
@@ -443,7 +446,8 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
                 microbatches: int = 0, pp_schedule: str = "auto",
                 zero_overlap: bool = False,
                 zero_bucket_mb: float = 4.0,
-                ps_wire: str = "f32", ps_mirror: bool = True) -> dict:
+                ps_wire: str = "f32", ps_mirror: bool = True,
+                verify: bool = False) -> dict:
     """STATIC per-step analytic of collective wire bytes for one
     parallel layout, composed from the parallel modules' own row
     builders (the formula lives next to the collective it prices).
@@ -454,7 +458,27 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
     bucketed/prefetched pattern, ``pp_schedule`` the tick table (zb's
     cotangent hops overlap the deferred-W slack). Returns {mode,
     rows: [{collective, axis, bytes, exposed_bytes, note}],
-    comm_bytes_per_step, comm_exposed_bytes_per_step}."""
+    comm_bytes_per_step, comm_exposed_bytes_per_step}.
+
+    The byte accounting is jaxpr-exact as of r18 (``tools/dttcheck``
+    proves it against the lowered computation, per mode):
+
+    - ZeRO rows price the PADDED flat chunking (every leaf zero-pads
+      to a multiple of D before psum_scatter/all_gather — the padding
+      lanes ride the wire like the live ones);
+    - the data-axis grad all-reduce prices each rank's ACTUAL payload
+      (stage/expert/TP-sharded leaves contribute their 1/K shard, not
+      the full leaf);
+    - PP/EP/SP rows include the model-axis collectives the old ledger
+      missed (replicated-leaf grad psums, the SP grad pmean) and the
+      ring rows count every schedule tick/hop the program executes.
+
+    ``verify=True`` machine-proves the returned ledger on the spot:
+    the step is traced chip-free over an abstract CPU mesh
+    (``tools/dttcheck.verify_ledger``) and any byte drift raises
+    ``ValueError`` naming the offending (collective family, axis)
+    group. A build/test-time instrument — it needs the repo's
+    ``tools/`` on the path and an 8-device CPU mesh."""
     import math
 
     import jax
@@ -465,16 +489,41 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
     if mode.startswith("zero"):
         zero_level = zero_level or int(mode[4:] or 0)
     params, _ = _abstract_state(model, None)
-    param_bytes = sum(
-        (math.prod(l.shape) if l.shape else 1) * np.dtype(l.dtype).itemsize
-        for l in jax.tree.leaves(params))
+    flat_params = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def _n(leaf) -> int:
+        return math.prod(leaf.shape) if leaf.shape else 1
+
+    param_bytes = sum(_n(l) * np.dtype(l.dtype).itemsize
+                      for _, l in flat_params)
     grad_bytes = param_bytes
+    # ZeRO's flat chunking zero-pads every leaf to a multiple of D
+    # before the scatter/gather — the padding lanes are real wire
+    # traffic (dttcheck-proven; the figures are what the chips move)
+    padded_bytes = sum(
+        (-(-_n(l) // data_ways)) * data_ways * np.dtype(l.dtype).itemsize
+        for _, l in flat_params)
+    # per-rank payloads for the data-axis all-reduce: sharded leaves
+    # (PP stages, EP experts, TP splits) contribute their 1/K shard
+    if mode in ("pp", "tp", "ep"):
+        div_fn = _param_divisor_fn(mode, data_ways, model_axis,
+                                   zero_level, params)
+    else:
+        div_fn = lambda path, leaf: 1  # noqa: E731
+    per_rank_grad_bytes = 0
+    rep_grad_bytes = 0
+    for path, leaf in flat_params:
+        isz = np.dtype(leaf.dtype).itemsize
+        d = max(1, int(div_fn(path, leaf)))
+        per_rank_grad_bytes += (_n(leaf) // d) * isz
+        if d == 1:
+            rep_grad_bytes += _n(leaf) * isz
     rows: list[dict] = []
 
     from distributed_tensorflow_tpu.parallel.zero import zero_comm_rows
 
     if mode in ("zero1", "zero3"):
-        rows += zero_comm_rows(grad_bytes, param_bytes, zero_level,
+        rows += zero_comm_rows(padded_bytes, padded_bytes, zero_level,
                                data_ways, overlap=bool(zero_overlap),
                                bucket_mb=float(zero_bucket_mb or 4.0))
     elif mode == "ps":
@@ -490,12 +539,13 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
     elif data_ways > 1:
         # every other multi-chip mode pays the plain DP grad all-reduce
         # over its data rows (dp_comm_rows delegates to the one
-        # all-reduce formula in zero_comm_rows level 0)
+        # all-reduce formula in zero_comm_rows level 0), at each rank's
+        # ACTUAL payload — model-axis-sharded leaves ride at 1/K
         from distributed_tensorflow_tpu.parallel.data_parallel import (
             dp_comm_rows,
         )
 
-        rows += dp_comm_rows(grad_bytes, data_ways)
+        rows += dp_comm_rows(per_rank_grad_bytes, data_ways)
 
     is_tf = type(model).__name__ in ("MiniTransformer", "TransformerLM")
     seq = getattr(model, "seq_len", 0)
@@ -510,21 +560,31 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
         act = -(-per_shard // micro) * seq * d_model * F32_BYTES
         rows += pp_comm_rows(act, model_axis, micro,
                              virtual_stages=max(1, int(virtual_stages)),
-                             schedule=pp_schedule)
+                             schedule=pp_schedule,
+                             rep_grad_bytes=rep_grad_bytes)
     elif mode == "tp" and model_axis > 1:
         from distributed_tensorflow_tpu.parallel.tensor_parallel import (
             tp_comm_rows,
         )
 
         per_shard = -(-int(batch_size) // data_ways)
+        keys = {_path_keys(path) for path, _ in flat_params}
         if is_tf:
+            # symmetric boundaries: attention-out + MLP-down per block,
+            # each psums a (B, S, d_model) tensor both directions
             act = per_shard * seq * d_model * F32_BYTES
-            n_sync = 2 * model.num_blocks  # attention + MLP row-splits
-        else:
-            act = per_shard * getattr(model, "hidden_units", 1024) \
-                * F32_BYTES
-            n_sync = 1  # the FC stack's one column->row boundary
-        rows += tp_comm_rows(act, n_sync)
+            n_sync = 2 * model.num_blocks
+            rows += tp_comm_rows(n_sync * act, n_sync * act)
+        elif ("weights", "wd1") in keys:
+            # the CNN FC stack: forward psums the row-split OUT
+            # matmul's (B, num_classes) partials; backward psums the
+            # cotangent at wd1's column-split (B, fc_in) input
+            fc_in = next(l.shape[0] for path, l in flat_params
+                         if _path_keys(path) == ("weights", "wd1"))
+            rows += tp_comm_rows(
+                per_shard * model.num_classes * F32_BYTES,
+                per_shard * fc_in * F32_BYTES)
+        # models without a split table shard nothing -> no TP rows
     elif mode == "ep" and model_axis > 1:
         from distributed_tensorflow_tpu.parallel.expert_parallel import (
             ep_comm_rows,
@@ -532,7 +592,8 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
 
         per_shard = -(-int(batch_size) // data_ways)
         act = per_shard * seq * d_model * F32_BYTES
-        rows += ep_comm_rows(act, getattr(model, "num_blocks", 1))
+        rows += ep_comm_rows(act, getattr(model, "num_blocks", 1),
+                             rep_grad_bytes=rep_grad_bytes)
     elif mode == "sp" and model_axis > 1:
         from distributed_tensorflow_tpu.parallel.sequence_parallel import (
             sp_comm_rows,
@@ -541,9 +602,10 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
         per_shard = -(-int(batch_size) // data_ways)
         kv_block = per_shard * (seq // model_axis) * d_model * F32_BYTES
         rows += sp_comm_rows(kv_block, model_axis,
-                             getattr(model, "num_blocks", 1))
+                             getattr(model, "num_blocks", 1),
+                             grad_bytes=grad_bytes)
 
-    return {
+    result = {
         "mode": mode, "data_ways": data_ways, "model_axis": model_axis,
         "rows": rows,
         "comm_bytes_per_step": int(sum(r["bytes"] for r in rows)),
@@ -552,6 +614,46 @@ def comm_ledger(model, optimizer=None, batch_size: int = 1, *,
         "comm_exposed_bytes_per_step": int(sum(
             r.get("exposed_bytes", r["bytes"]) for r in rows)),
     }
+    if verify:
+        result["verified"] = _verify_ledger(
+            model, optimizer, batch_size, result, mode=mode,
+            data_ways=data_ways, model_axis=model_axis,
+            zero_level=zero_level, virtual_stages=virtual_stages,
+            microbatches=microbatches, pp_schedule=pp_schedule,
+            zero_overlap=zero_overlap, zero_bucket_mb=zero_bucket_mb)
+    return result
+
+
+def _verify_ledger(model, optimizer, batch_size, ledger, **cfg) -> bool:
+    """The ``comm_ledger(verify=True)`` hook body: trace the REAL step
+    for this layout chip-free (tools/dttcheck) and require byte-exact
+    agreement; any drift raises ValueError naming the group."""
+    import os
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    try:
+        from tools.dttcheck import verify_ledger
+    except ImportError as e:
+        raise RuntimeError(
+            f"comm_ledger(verify=True) needs the repo's tools/ tree "
+            f"(tools.dttcheck): {e}") from None
+    if optimizer is None:
+        # the proof needs a runnable update; collective volume does not
+        # depend on the optimizer family (grads/slots mirror params)
+        from distributed_tensorflow_tpu.training.train_state import sgd
+
+        optimizer = sgd(0.01)
+    findings = verify_ledger(model, optimizer, batch_size, ledger, **cfg)
+    if findings:
+        raise ValueError(
+            "comm_ledger(verify=True): the analytic rows do not match "
+            "the lowered computation:\n  "
+            + "\n  ".join(f.message for f in findings))
+    return True
 
 
 # ---------------------------------------------------- recompile sentry

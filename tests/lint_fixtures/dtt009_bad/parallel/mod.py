@@ -1,0 +1,22 @@
+"""DTT009 bad fixture: one traced collective path, one ORPHAN."""
+from jax import lax
+
+DATA_AXIS = "data"
+
+
+def make_traced_step():
+    """Referenced by the fixture's tools/dttcheck — covered."""
+
+    def per_shard(x):
+        return lax.pmean(_helper_collective(x), DATA_AXIS)
+
+    return per_shard
+
+
+def _helper_collective(x):
+    return lax.all_gather(x, DATA_AXIS, tiled=True)
+
+
+def orphan_collective_path(x):
+    """A new comm path NO dttcheck scenario traces — the finding."""
+    return lax.psum(x, DATA_AXIS)

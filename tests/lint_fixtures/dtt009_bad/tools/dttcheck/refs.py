@@ -1,0 +1,4 @@
+"""Fixture dttcheck: references only the traced builder."""
+from parallel.mod import make_traced_step
+
+SCENARIOS = (make_traced_step,)

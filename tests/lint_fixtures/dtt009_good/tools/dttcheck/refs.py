@@ -1,0 +1,4 @@
+"""Fixture dttcheck: every collective path is traced."""
+from parallel.mod import make_traced_step, orphan_collective_path
+
+SCENARIOS = (make_traced_step, orphan_collective_path)

@@ -4,6 +4,8 @@ Constants are shrunk via monkeypatch; the point is that every branch —
 mesh build, sharded prefetch staging, dp eval on the device-resident test
 set, the feed-dict baseline — compiles and executes, not the numbers."""
 
+import time
+
 import jax
 import numpy as np
 import pytest
@@ -325,9 +327,16 @@ def test_degraded_record_keeps_schedule_facts_non_null():
     # (dttlint is pure ast, no backend at all) — asserted here instead
     # of paying a second full degraded_record build
     assert rec["lint_findings_total"] == 0
-    assert rec["lint_rules"] == 8
+    assert rec["lint_rules"] == 9
     assert rec["lint_baselined_total"] is not None
     assert rec["lint_time_s"] is not None
+    # r18: the jaxpr-proof facts ride the degraded record too (the
+    # dttcheck drill runs in its own CPU-mesh subprocess, no backend
+    # dependence; per-process cache makes this ride-along free here)
+    assert rec["jaxprcheck_findings_total"] == 0
+    assert rec["jaxprcheck_modes_proven"] == 8
+    assert rec["jaxprcheck_collectives_total"] > 0
+    assert rec["jaxprcheck_time_s"] is not None
 
 
 def test_pp_skip_record_carries_schedule_facts():
@@ -477,15 +486,33 @@ def test_overlap_phase_skips_on_one_chip(ds):
 
 def test_lint_phase_runs_clean_and_fast():
     """r16: the dttlint drill — zero non-baselined findings with the
-    checked-in baseline, all eight rules, inside the <10s acceptance
-    budget (pure ast, no chip)."""
+    checked-in baseline, all nine rules (DTT009 since r18), inside the
+    <10s acceptance budget (pure ast, no chip)."""
     out = bench.lint_phase()
     assert out["lint_findings_total"] == 0, out
     assert out["lint_stale_suppressions"] == 0
-    assert out["lint_rules"] == 8
+    assert out["lint_rules"] == 9
     assert out["lint_baselined_total"] >= 0
     assert out["lint_time_s"] < 10.0
     assert "lint_error" not in out
     # the degraded-record ride-along is asserted in
     # test_degraded_record_keeps_schedule_facts_non_null (one shared
     # degraded_record build instead of two)
+
+
+def test_jaxprcheck_phase_proves_the_full_matrix():
+    """r18: the dttcheck drill — the comm ledgers and SPMD safety
+    machine-proven against the lowered computation for ALL EIGHT modes
+    in the phase's own CPU-mesh subprocess, zero findings. Cached per
+    process (the degraded record re-emits the same facts free)."""
+    out = bench.jaxprcheck_phase()
+    assert out["jaxprcheck_findings_total"] == 0, out
+    assert out["jaxprcheck_modes_proven"] == 8
+    assert out["jaxprcheck_collectives_total"] > 0
+    assert out["jaxprcheck_time_s"] is not None
+    assert "jaxprcheck_error" not in out
+    # the per-process cache: a second call must not pay the subprocess
+    t0 = time.perf_counter()
+    again = bench.jaxprcheck_phase()
+    assert time.perf_counter() - t0 < 1.0
+    assert again == out

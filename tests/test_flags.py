@@ -117,3 +117,33 @@ def test_core_flag_validators_accept_defaults_and_known_names():
                         "--lr_schedule=cosine", "--prng=rbg",
                         "--ps_wire=bf16", "--mode=sync"])
     assert flags.FLAGS.model == "lm"
+
+
+# ---- r18 (dttlint DTT006 baseline shrink): loud-pairing validators -------
+
+
+@pytest.mark.parametrize("argv,needle", [
+    (["--job_name=chief"], "--job_name"),
+    (["--sp_span_hosts"], "sp_span_hosts"),
+    (["--pallas", "--model=lm"], "--pallas"),
+    (["--pallas", "--model=mlp"], "--pallas"),
+    (["--augment", "--dataset=lm"], "--augment"),
+])
+def test_pairing_validators_reject_at_parse_time(argv, needle):
+    """The r18 shrink: five DTT006 baseline entries became real
+    parse-time checks — a flag that would be silently inert (or
+    invalid) for the named configuration now fails at the command
+    line, flag NAMED. The overlapping train()-time library guards
+    stay for non-CLI callers (test_lm pins one)."""
+    with pytest.raises(ValueError, match=needle):
+        flags.FLAGS._parse(argv)
+
+
+def test_pairing_validators_accept_valid_combinations():
+    flags.FLAGS._parse(["--job_name=worker", "--augment",
+                        "--pallas", "--model=deep_cnn"])
+    assert flags.FLAGS.pallas and flags.FLAGS.augment
+    flags.FLAGS._reset()
+    flags.FLAGS._parse(["--seq_parallel", "--sp_span_hosts",
+                        "--model=lm", "--dataset=lm", "--model_axis=2"])
+    assert flags.FLAGS.sp_span_hosts

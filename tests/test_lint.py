@@ -30,6 +30,7 @@ from tools.dttlint.rules import (  # noqa: E402
     rule_scalar_contract,
     rule_span_taxonomy,
     rule_trace_purity,
+    rule_traced_coverage,
 )
 
 FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
@@ -71,6 +72,8 @@ FIXTURE_MATRIX = [
      "DTT007", 5),
     (rule_donation_safety, "", ("dtt008_bad.py",), ("dtt008_good.py",),
      "DTT008", 1),
+    (rule_traced_coverage, "dtt009_bad",
+     ("parallel/mod.py", "tools/dttcheck/refs.py"), None, "DTT009", 1),
 ]
 
 
@@ -137,7 +140,7 @@ def test_repo_lints_clean_with_checked_in_baseline():
     assert res.findings == [], \
         "new findings:\n" + "\n".join(f.format() for f in res.findings)
     assert res.stale == [], res.stale
-    assert len(res.rules) == 8
+    assert len(res.rules) == 9
     assert dt < 10.0, f"lint took {dt:.1f}s (>10s acceptance budget)"
     assert res.baselined, "baseline is empty — update this test if " \
                           "the tree went fully clean"
@@ -182,7 +185,7 @@ def test_cli_exits_zero_and_emits_json():
     assert p.returncode == 0, p.stdout + p.stderr
     out = json.loads(p.stdout)
     assert out["ok"] and out["findings"] == []
-    assert len(out["rules"]) == 8
+    assert len(out["rules"]) == 9
 
 
 def test_cli_exits_nonzero_on_new_violation(tmp_path):
@@ -236,4 +239,18 @@ def test_scalar_contract_sees_all_loop_variants():
 
 def test_all_rules_registered():
     assert [r.rule_id for r in ALL_RULES] == [
-        f"DTT00{i}" for i in range(1, 9)]
+        f"DTT00{i}" for i in range(1, 10)]
+
+
+def test_dtt009_names_the_orphan_and_guards_self_disable():
+    """The orphan site is NAMED; and a walk set with parallel/
+    collectives but no tools/dttcheck sources is itself a finding
+    (the rule must not silently self-disable)."""
+    res = _lint(rule_traced_coverage, "dtt009_bad",
+                "parallel/mod.py", "tools/dttcheck/refs.py")
+    assert [f.key for f in res.findings] == [
+        "parallel/mod.py::orphan_collective_path"]
+    assert "machine-unproven" in res.findings[0].message
+    res2 = _lint(rule_traced_coverage, "dtt009_bad", "parallel/mod.py")
+    assert [f.rule for f in res2.findings] == ["DTT009"]
+    assert "self-disable" in res2.findings[0].message

@@ -409,17 +409,29 @@ def test_sp_full_split_eval_matches_dense():
 
 def test_sp_span_flag_requires_seq_parallel(tmp_path):
     """--sp_span_hosts without --seq_parallel must refuse loudly (the
-    loud-pairing convention), not silently train a different mode."""
+    loud-pairing convention), not silently train a different mode —
+    at PARSE time since r18 (the check was promoted out of the
+    dttlint DTT006 baseline into _validate_pairing_flags), and the
+    train()-time library guard stays for non-CLI callers."""
     from distributed_tensorflow_tpu import flags
     from distributed_tensorflow_tpu.training.loop import train
 
     flags.define_reference_flags()
     flags.FLAGS._reset()
-    flags.FLAGS._parse([
-        f"--logdir={tmp_path}/l", f"--data_dir={tmp_path}/n",
-        "--sp_span_hosts", "--model_axis=8", "--training_iter=1",
-    ])
     try:
+        with pytest.raises(ValueError, match="sp_span_hosts"):
+            flags.FLAGS._parse([
+                f"--logdir={tmp_path}/l", f"--data_dir={tmp_path}/n",
+                "--sp_span_hosts", "--model_axis=8",
+                "--training_iter=1",
+            ])
+        # the library-level guard, for callers that never parse argv
+        flags.FLAGS._reset()
+        flags.FLAGS._parse([
+            f"--logdir={tmp_path}/l", f"--data_dir={tmp_path}/n",
+            "--model_axis=8", "--training_iter=1",
+        ])
+        flags.FLAGS.sp_span_hosts = True  # post-parse, bypasses validators
         with pytest.raises(ValueError, match="sp_span_hosts"):
             train(flags.FLAGS, mode="sync")
     finally:

@@ -164,13 +164,31 @@ def test_zb_layout_validation():
 
 def test_pp_comm_rows_zb_exposure():
     """The ledger prices zb's backward ring as overlapped (the
-    deferred-W slack) and the AD schedules as fully exposed."""
+    deferred-W slack) and the AD schedules as fully exposed. Byte
+    volume is TICK-exact per schedule (r18, dttcheck-proven): the ring
+    fires every tick of ITS OWN table, so zb — whose combined F/B/W
+    table runs more ticks — moves more ring bytes than the AD
+    schedules at the same (K, M, V); its win is exposure, not volume."""
+    from distributed_tensorflow_tpu.parallel.pp_schedule import (
+        build_pp_schedule,
+        build_zb_schedule,
+    )
+
     ad = pp_comm_rows(1000, 2, 4, 1, schedule="interleaved")
     zb = pp_comm_rows(1000, 2, 4, 1, schedule="zb")
-    assert [r["bytes"] for r in ad] == [r["bytes"] for r in zb]
+    t_ad = build_pp_schedule(2, 4, 1).num_ticks
+    t_zb = build_zb_schedule(2, 4, 1).num_ticks
+    assert [r["bytes"] for r in ad[:2]] == [1000 * t_ad] * 2
+    assert [r["bytes"] for r in zb[:2]] == [1000 * t_zb] * 2
+    assert t_zb > t_ad
     assert all(r["exposed_bytes"] == r["bytes"] for r in ad)
     assert zb[0]["exposed_bytes"] == zb[0]["bytes"]  # forward exposed
     assert zb[1]["exposed_bytes"] == 0               # cotangents hidden
+    # the degenerate 1-stage layout has no ring and no stage axis —
+    # no rows, whatever the schedule asks for
+    assert pp_comm_rows(1000, 1, 4, 1, schedule="gpipe") == []
+    assert pp_comm_rows(1000, 1, 4, 1, schedule="zb",
+                        rep_grad_bytes=10) == []
 
 
 # ------------------------------------------- exact-trajectory equality

@@ -125,39 +125,77 @@ def test_budget_without_optimizer_prices_params_only():
 # --------------------------------------------------------- comm ledger
 
 
+def _padded_param_bytes(model, d: int) -> int:
+    """ZeRO's wire payload: every leaf zero-pads to a multiple of the
+    data-axis size before the flat chunking (the r18 jaxpr-proven
+    convention — padding lanes ride the wire)."""
+    import jax
+
+    params = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        total += (-(-n // d)) * d * np.dtype(leaf.dtype).itemsize
+    return total
+
+
 def test_comm_ledger_dp_and_zero_pins():
-    """DP moves ~2|G|; ZeRO-1 moves |G|+|P| — the r10 doc table as
-    ledger rows, hand-pinned against the param byte count."""
+    """DP moves ~2|G|; ZeRO moves |G|+|P| at BOTH levels over the
+    PADDED flat layout — the r10 doc table as ledger rows, hand-pinned
+    and r18 jaxpr-proven (dttcheck found the pre-r18 rows priced
+    unpadded bytes and a phantom level-3 backward re-gather: the
+    checkpointed gather's output is itself the saved residual)."""
     model, opt = _cnn(), _adam()
     g = resources.resource_budget(model, opt, 128)["param_bytes_full"]
+    gp = _padded_param_bytes(model, 8)
+    assert gp > g  # the flagship CNN has non-multiple-of-8 leaves
     dp = resources.comm_ledger(model, opt, 128, mode="dp", data_ways=8)
-    assert dp["comm_bytes_per_step"] == 2 * g
+    assert dp["comm_bytes_per_step"] == 2 * g  # unpadded: plain pmean
     z1 = resources.comm_ledger(model, opt, 128, mode="zero1",
                                data_ways=8, zero_level=1)
-    assert z1["comm_bytes_per_step"] == 2 * g  # |G| + |P|, |P| == |G|
+    assert z1["comm_bytes_per_step"] == 2 * gp  # |G|+|P| padded
     assert {r["collective"] for r in z1["rows"]} == {
         "psum_scatter(grads)", "all_gather(params)"}
     z3 = resources.comm_ledger(model, opt, 128, mode="zero3",
                                data_ways=8, zero_level=3)
-    assert z3["comm_bytes_per_step"] == 3 * g  # |G| + 2|P| (fwd+bwd)
+    # |G| + ONE |P|: the serial path gathers once per step — no
+    # backward re-gather reaches the wire (dttcheck-proven)
+    assert z3["comm_bytes_per_step"] == 2 * gp
+    assert {r["collective"] for r in z3["rows"]} == {
+        "reduce_scatter(grad transpose)", "all_gather(params, forward)"}
     # one chip moves nothing
     local = resources.comm_ledger(model, opt, 128, mode="dp", data_ways=1)
     assert local["comm_bytes_per_step"] == 0
 
 
 def test_comm_ledger_pp_hand_pinned():
-    """PP boundary bytes: M microbatches x (K*V - 1) hops x activation,
-    forward and backward."""
+    """PP ring bytes are TICK-exact (r18): one activation slot permutes
+    on EVERY tick of the static schedule — bubble ticks included —
+    each direction, plus the replicated-leaf grad psum over the stage
+    axis the pre-r18 ledger missed."""
+    import jax
+
     lm = _lm(seq_len=32, d_model=32)
     led = resources.comm_ledger(lm, _adam(), 16, mode="pp", data_ways=2,
                                 model_axis=2, microbatches=2,
                                 virtual_stages=2)
     act = (16 // 2 // 2) * 32 * 32 * 4   # per-microbatch (B/d/M, S, d) f32
-    hops = 2 * 2 - 1
+    ticks = 2 * 2 + 2 - 1                # M*V + K - 1
+    # replicated leaves: everything outside the blocks list
+    params = jax.eval_shape(lambda: lm.init(jax.random.PRNGKey(0)))
+    rep = sum(int(np.prod(l.shape)) * 4
+              for key in ("tok", "pos", "ln_f", "head")
+              for l in jax.tree.leaves(params[key]))
     pp_rows = [r for r in led["rows"] if r["axis"] == "model"]
-    assert sum(r["bytes"] for r in pp_rows) == 2 * 2 * hops * act
-    # the data-axis grad all-reduce rides along
-    assert any(r["axis"] == "data" for r in led["rows"])
+    assert sum(r["bytes"] for r in pp_rows) == 2 * ticks * act + 2 * rep
+    ring = [r for r in pp_rows if "ppermute" in r["collective"]]
+    assert [r["bytes"] for r in ring] == [ticks * act, ticks * act]
+    # the data-axis grad all-reduce rides along, at the PER-RANK
+    # payload: block leaves contribute their 1/K stage shard
+    data_rows = [r for r in led["rows"] if r["axis"] == "data"]
+    blocks = sum(int(np.prod(l.shape)) * 4
+                 for l in jax.tree.leaves(params["blocks"]))
+    assert sum(r["bytes"] for r in data_rows) == 2 * (rep + blocks // 2)
 
 
 def test_comm_ledger_tp_ep_sp_rows():

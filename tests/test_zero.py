@@ -685,9 +685,12 @@ def test_bucketed_collectives_match_per_leaf(mesh):
 
 def test_zero_comm_rows_overlap_exposure():
     """The ledger's overlap pricing: serial rows expose everything;
-    overlap exposes one bucket per collective, drops the level-3 remat
-    re-gather row entirely (|G|+2|P| -> |G|+|P| on the wire), and
-    prices the prefetched gather at zero exposure."""
+    overlap exposes one bucket per collective and prices the
+    prefetched gather at zero exposure. Level-3 wire volume is
+    |G| + |P| in BOTH schedules (r18, dttcheck-proven: the serial
+    path's checkpointed gather output is itself the saved residual —
+    no backward re-gather ever reaches the wire); overlap's win is
+    the EXPOSED column, not the volume."""
     from distributed_tensorflow_tpu.parallel.zero import (
         zero_comm_rows,
         zero_exposed_comm_bytes,
@@ -696,10 +699,12 @@ def test_zero_comm_rows_overlap_exposure():
     G = 10 * 2 ** 20
     bucket = 1.0  # MB
     serial3 = zero_comm_rows(G, G, 3, 8)
-    assert sum(r["bytes"] for r in serial3) == 3 * G
+    assert sum(r["bytes"] for r in serial3) == 2 * G
+    assert {r["collective"] for r in serial3} == {
+        "reduce_scatter(grad transpose)", "all_gather(params, forward)"}
     assert all(r["exposed_bytes"] == r["bytes"] for r in serial3)
     over3 = zero_comm_rows(G, G, 3, 8, overlap=True, bucket_mb=bucket)
-    assert sum(r["bytes"] for r in over3) == 2 * G  # remat gather gone
+    assert sum(r["bytes"] for r in over3) == 2 * G  # same volume
     gather = [r for r in over3 if "prefetched" in r["collective"]]
     assert gather and gather[0]["exposed_bytes"] == 0
     assert zero_exposed_comm_bytes(G, G, 3, 8, True, bucket) == 2 ** 20

@@ -1,0 +1,118 @@
+"""Shared static-analysis runner infrastructure — the baseline-with-
+reason / stale-fails machinery both in-tree analyzers ride:
+
+- ``tools/dttlint`` — the AST invariant linter (r16), and
+- ``tools/dttcheck`` — the jaxpr-level ledger/SPMD verifier (r18).
+
+One ``Finding`` shape, one baseline format, one matching rule, so a
+suppression behaves identically whichever layer produced the finding:
+the checked-in baseline suppresses by STABLE key (symbols, never line
+numbers), every entry carries a mandatory ``reason``, and an entry
+whose finding no longer exists FAILS the run loudly — the baseline can
+only shrink. Factored out of ``tools/dttlint`` when dttcheck became
+its second consumer (the jaxpr layer must not fork the suppression
+semantics the AST layer's tests already pin).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@dataclass
+class Finding:
+    """One rule/pass violation. ``key`` is the STABLE identity (no line
+    numbers — lines churn, keys must survive unrelated edits) the
+    baseline suppresses by; ``path``/``line`` locate it for humans."""
+
+    rule: str
+    key: str
+    path: str
+    line: int
+    message: str
+    baselined: bool = False
+    # --fix support (dttlint DTT001): the literal to rewrite, when
+    # the fix is mechanical
+    fix: dict | None = None
+
+    def format(self) -> str:
+        tag = " [baselined]" if self.baselined else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{tag}"
+
+
+@dataclass
+class AnalysisResult:
+    """The runner's verdict: non-baselined findings, matched
+    suppressions, stale suppressions, and the rule/pass registry that
+    ran. ``ok`` is the exit-code contract shared by both CLIs."""
+
+    findings: list = field(default_factory=list)  # non-baselined
+    baselined: list = field(default_factory=list)
+    stale: list = field(default_factory=list)  # baseline keys w/o finding
+    rules: tuple = ()
+    report: dict = field(default_factory=dict)  # analyzer-specific facts
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.stale
+
+    def to_json(self) -> dict:
+        def row(f):
+            return {"rule": f.rule, "key": f.key, "path": f.path,
+                    "line": f.line, "message": f.message}
+
+        out = {
+            "ok": self.ok,
+            "findings": [row(f) for f in self.findings],
+            "baselined": [row(f) for f in self.baselined],
+            "stale_suppressions": list(self.stale),
+            "rules": list(self.rules),
+        }
+        if self.report:
+            out["report"] = self.report
+        return out
+
+
+def load_baseline(path: str | None, default_path: str) -> list[dict]:
+    """Read a suppression file; every entry must carry rule, key and a
+    REASON (the reason IS the suppression's justification — an entry
+    without one is an unexplained mute and is rejected)."""
+    path = path or default_path
+    if not os.path.exists(path):
+        return []
+    data = json.load(open(path, encoding="utf-8"))
+    entries = data.get("entries", [])
+    for e in entries:
+        if not {"rule", "key", "reason"} <= set(e):
+            raise ValueError(
+                f"baseline entry {e!r} must carry rule, key and reason "
+                f"(the reason IS the suppression's justification)")
+    return entries
+
+
+def apply_baseline(found: list, entries: list[dict], rules: tuple,
+                   report: dict | None = None) -> AnalysisResult:
+    """Split raw findings into (new, baselined) and detect stale
+    suppressions — the one matching rule both analyzers share. Stale
+    entries are only charged against rules/passes that actually RAN
+    (``rules``), so a partial run (--mode/--rules filters) cannot
+    spuriously fail entries belonging to skipped checks."""
+    by_key = {(e["rule"], e["key"]): e for e in entries}
+    result = AnalysisResult(rules=tuple(rules), report=dict(report or {}))
+    matched = set()
+    for f in sorted(found, key=lambda f: (f.path, f.line, f.rule)):
+        hit = by_key.get((f.rule, f.key))
+        if hit is not None:
+            f.baselined = True
+            matched.add((f.rule, f.key))
+            result.baselined.append(f)
+        else:
+            result.findings.append(f)
+    checked = set(result.rules)
+    result.stale = [f"{r}:{k}" for (r, k) in by_key
+                    if (r, k) not in matched and r in checked]
+    return result

@@ -1,0 +1,193 @@
+"""dttcheck — the jaxpr-level verifier: prove the analytic ledgers and
+SPMD safety against the lowered computation (r18).
+
+The reference framework's capability rested on TF-runtime GRAPH
+machinery — placement validation and graph partitioning ran on the
+dataflow graph, not the Python source. This repo's static layer had
+only the AST half (``tools/dttlint``, r16); the load-bearing numeric
+claims — the comm ledger's wire bytes (r13/r14), donation safety,
+collective deadlock-freedom — are properties of the lowered jaxpr,
+and until r18 they rested on hand-maintained ``*_comm_rows`` builders
+and runtime chaos tests. dttcheck closes that gap: every
+(parallel-mode x model) step function in the scenario matrix is traced
+chip-free via ``jax.make_jaxpr`` over an abstract 8-device CPU mesh
+(GSPMD modes compile tiny CPU HLO instead — their collectives only
+exist after the SPMD partitioner), the equations are walked into a
+collective inventory, and four passes check it:
+
+  DTC001 ledger-proof        comm_ledger rows == traced collectives,
+                             byte-exact, both directions
+  DTC002 spmd-deadlock       cond branches carry identical collective
+                             signatures; axis names exist on the mesh
+  DTC003 donation-audit      donated buffers actually alias an output
+  DTC004 replication-drift   plan-declared shards are really split in
+                             the lowered program
+
+ROADMAP item 1's auto-planner consumes the analytic duals this proves
+(predicted step time = max(compute, exposed comm)); a cost model the
+machine has verified against the lowered program is one the planner
+can trust.
+
+Run it: ``python -m tools.dttcheck [--json] [--mode M] [--model M]``.
+Exit 0 = no non-baselined findings and no stale suppressions — the
+dttlint contract, riding the same ``tools/_analysis_common`` baseline
+machinery (suppress by stable key, mandatory reason, stale entries
+fail, the baseline only shrinks). ``utils/resources.comm_ledger(...,
+verify=True)`` calls :func:`verify_ledger` to machine-prove a ledger
+for any model at build time.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from tools._analysis_common import (  # noqa: E402
+    REPO_ROOT,
+    AnalysisResult,
+    Finding,
+    apply_baseline,
+    load_baseline as _load_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+ALL_PASSES = ("DTC000", "DTC001", "DTC002", "DTC003", "DTC004")
+
+CheckResult = AnalysisResult
+
+
+def load_baseline(path: str | None = None) -> list[dict]:
+    return _load_baseline(path, DEFAULT_BASELINE)
+
+
+def _check_target(target, found: list, report_rows: list) -> None:
+    import time
+
+    from tools.dttcheck import passes
+    from tools.dttcheck.inventory import hlo_inventory, trace_inventory
+
+    t0 = time.perf_counter()
+    ledger = None
+    if target.ledger_kwargs is not None:
+        from distributed_tensorflow_tpu.utils.resources import comm_ledger
+
+        ledger = comm_ledger(target.model, target.optimizer,
+                             target.batch_size, **target.ledger_kwargs)
+    closed, inv = trace_inventory(target.step_fn, target.args)
+    if target.hlo:
+        compiled = target.step_fn.lower(*target.args).compile()
+        inv = hlo_inventory(compiled.as_text(), target.mesh)
+    n_findings = len(found)
+    if ledger is not None:
+        found.extend(passes.pass_ledger(target, inv, ledger))
+    found.extend(passes.pass_deadlock(target, inv, ledger))
+    found.extend(passes.pass_donation(target, closed))
+    if target.hlo:
+        found.extend(passes.pass_replication_gspmd(target))
+    else:
+        found.extend(passes.pass_replication(target, closed))
+    report_rows.append({
+        "scenario": target.name, "mode": target.mode,
+        "model": target.model_name,
+        "source": "hlo" if target.hlo else "jaxpr",
+        "collectives": len(inv.priced()),
+        "wire_bytes": inv.total_bytes(),
+        "control": len(inv.control()),
+        "ledger_proven": bool(ledger is not None
+                              and len(found) == n_findings),
+        "time_s": round(time.perf_counter() - t0, 3),
+    })
+
+
+def run_check(baseline_path: str | None = None, *, modes=None,
+              models=None, scenarios=None) -> CheckResult:
+    """The one entry point (CLI, tier-1 gate, bench jaxprcheck_phase).
+    ``modes``/``models`` filter the matrix (bring-up ergonomics);
+    ``scenarios`` overrides it entirely (tests inject fixtures)."""
+    from tools.dttcheck.scenarios import SCENARIOS, ensure_cpu_mesh
+
+    ensure_cpu_mesh()
+    selected = list(scenarios) if scenarios is not None else [
+        s for s in SCENARIOS
+        if (not modes or s.mode in modes)
+        and (not models or s.model_name in models)]
+    found: list = []
+    rows: list = []
+    for sc in selected:
+        try:
+            target = sc.build()
+        except Exception as e:  # noqa: BLE001 — a broken build IS a finding
+            found.append(Finding(
+                "DTC000", f"build:{sc.name}", "tools/dttcheck", 0,
+                f"[{sc.name}] scenario failed to BUILD: "
+                f"{type(e).__name__}: {e}"))
+            continue
+        try:
+            _check_target(target, found, rows)
+        except Exception as e:  # noqa: BLE001
+            found.append(Finding(
+                "DTC000", f"trace:{sc.name}", "tools/dttcheck", 0,
+                f"[{sc.name}] scenario failed to TRACE/CHECK: "
+                f"{type(e).__name__}: {e}"))
+    failed = {f.key.split(":", 2)[1] if ":" in f.key else ""
+              for f in found}
+    # demote a mode for ANY failed scenario of that mode — including
+    # DTC000 build/trace failures, which never reach a report row (a
+    # step the verifier cannot trace is a step nobody has proven
+    # anything about, so its mode must not read as proven)
+    mode_of = {sc.name: sc.mode for sc in selected}
+    failed_modes = {mode_of[n] for n in failed if n in mode_of} | {
+        r["mode"] for r in rows if r["scenario"] in failed}
+    proven_modes = sorted({
+        r["mode"] for r in rows
+        if r["ledger_proven"]} - failed_modes)
+    report = {
+        "scenarios": rows,
+        "modes_proven": proven_modes,
+        "collectives_total": sum(r["collectives"] for r in rows),
+        "wire_bytes_total": sum(r["wire_bytes"] for r in rows),
+    }
+    result = apply_baseline(found, load_baseline(baseline_path),
+                            rules=ALL_PASSES, report=report)
+    if scenarios is not None or modes or models:
+        # the __main__ contract: a filtered bring-up run only charges
+        # stale against scenarios that RAN (every pass runs for every
+        # scenario, so apply_baseline's rule-id scoping can't scope
+        # this — finding keys embed the scenario name instead). The
+        # unfiltered run stays the court where dead entries fail.
+        ran = {sc.name for sc in selected}
+
+        def _scenario_of(stale: str) -> str:
+            parts = stale.split(":", 1)[1].split(":")
+            return parts[1] if len(parts) > 1 else ""
+
+        result.stale = [s for s in result.stale
+                        if _scenario_of(s) in ran]
+    return result
+
+
+def verify_ledger(model, optimizer, batch_size: int, ledger: dict,
+                  **cfg) -> list:
+    """Machine-prove ONE ledger against its traced step — the
+    ``utils/resources.comm_ledger(verify=True)`` hook. Returns the
+    DTC001/DTC002 findings (empty = proven). Raises RuntimeError when
+    no big-enough CPU mesh is available (the hook is a build/test-time
+    instrument, not a runtime one)."""
+    from tools.dttcheck import passes
+    from tools.dttcheck.inventory import hlo_inventory, trace_inventory
+    from tools.dttcheck.scenarios import build_from_config, ensure_cpu_mesh
+
+    ensure_cpu_mesh()
+    target = build_from_config(model, optimizer, batch_size,
+                               name=f"verify/{cfg.get('mode', 'dp')}",
+                               **cfg)
+    closed, inv = trace_inventory(target.step_fn, target.args)
+    if target.hlo:
+        compiled = target.step_fn.lower(*target.args).compile()
+        inv = hlo_inventory(compiled.as_text(), target.mesh)
+    return (passes.pass_ledger(target, inv, ledger)
+            + passes.pass_deadlock(target, inv, ledger))

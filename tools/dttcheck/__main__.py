@@ -1,0 +1,88 @@
+"""CLI: ``python -m tools.dttcheck [--json] [--mode M] [--model M]
+[--baseline PATH] [--inventory]``.
+
+Exit status is the tier-1 contract (dttlint's): 0 when every scenario
+traces clean — ledger bytes proven equal to the jaxpr-derived bytes,
+no divergent cond branches, no wasted donation, no replication drift —
+and no stale suppressions; 1 otherwise.
+
+``--mode`` / ``--model`` filter the scenario matrix for bring-up
+(``--mode zero1 --mode zero3``); stale-suppression accounting still
+only charges the passes that ran. ``--inventory`` prints the per-
+scenario collective inventory table (family, axes, trips, wire bytes)
+instead of just the verdict — the human-readable view of what the
+proof measured.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# tools/ convention: runnable as a script too
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.dttcheck import DEFAULT_BASELINE, run_check  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dttcheck",
+        description="dttcheck — the jaxpr-level ledger/SPMD verifier "
+                    "(passes DTC001-DTC004; see docs/ARCHITECTURE.md "
+                    "'Jaxpr verification')")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON object")
+    ap.add_argument("--mode", action="append", default=None,
+                    help="restrict to one parallel mode (repeatable): "
+                         "dp zero1 zero3 pp tp ep sp ps")
+    ap.add_argument("--model", action="append", default=None,
+                    help="restrict to one model (repeatable): "
+                         "deep_cnn mlp lm lm_moe")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression file (default: the checked-in "
+                         "tools/dttcheck/baseline.json)")
+    ap.add_argument("--inventory", action="store_true",
+                    help="print the per-scenario collective inventory")
+    args = ap.parse_args(argv)
+
+    # the 8-device CPU mesh must exist BEFORE jax spins up — run_check
+    # handles it, but fail early with the real message if jax snuck in
+    result = run_check(args.baseline, modes=args.mode, models=args.model)
+
+    if args.json:
+        print(json.dumps(result.to_json()))
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(f.format())
+    for key in result.stale:
+        print(f"{args.baseline}: STALE suppression {key} — the finding "
+              f"no longer exists; delete the entry (the baseline only "
+              f"shrinks)")
+    rows = result.report.get("scenarios", [])
+    if args.inventory:
+        print(f"{'scenario':<26} {'src':<6} {'colls':>5} "
+              f"{'wire bytes':>14} {'ctrl':>4} {'ledger':>7} "
+              f"{'time':>7}")
+        for r in rows:
+            print(f"{r['scenario']:<26} {r['source']:<6} "
+                  f"{r['collectives']:>5} {r['wire_bytes']:>14,} "
+                  f"{r['control']:>4} "
+                  f"{'proven' if r['ledger_proven'] else '-':>7} "
+                  f"{r['time_s']:>6.2f}s")
+    print(f"dttcheck: {len(result.findings)} finding(s), "
+          f"{len(result.baselined)} baselined, "
+          f"{len(result.stale)} stale suppression(s); "
+          f"{len(rows)} scenario(s), "
+          f"modes proven: {result.report.get('modes_proven')}, "
+          f"{result.report.get('collectives_total')} collectives, "
+          f"{result.report.get('wire_bytes_total', 0):,} wire bytes")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,504 @@
+"""The dttcheck scenario matrix: one traceable step function per
+(parallel-mode x model) cell, built over an abstract 8-device CPU mesh.
+
+Each scenario instantiates the REAL builder the training loop uses
+(``make_dp_train_step`` / ``make_zero_train_step`` /
+``make_pp_train_step`` / ``make_tp_train_step`` / ``make_ep_train_step``
+/ ``make_sp_train_step`` / ``ps_emulation.make_grad_fn`` and the eval
+twins) on a small-but-structurally-faithful model, so what dttcheck
+proves is the program the loops dispatch — not a reimplementation.
+Models are kept tiny (tracing cost is Python time, and the repo-wide
+pytest gate carries a <15s chip-free budget); every byte formula under
+proof is size-generic, so small shapes prove the same algebra the
+flagship shapes run.
+
+``build_from_config`` is the generic (model, optimizer, batch, layout)
+-> traceable-target assembly — the same entry the
+``utils/resources.comm_ledger(verify=True)`` hook uses, so a ledger
+can be machine-proven for ANY model the caller prices, not just the
+canonical matrix below.
+
+Scenario fields drive the four passes:
+
+- ``ledger_kwargs`` — the ``utils/resources.comm_ledger`` layout this
+  step corresponds to (None = the scenario skips the ledger proof:
+  clip-transform variants add real clip-norm collectives the ledger
+  deliberately does not price, and eval steps have no training ledger).
+- ``plan`` — the declared :class:`ParallelismPlan` facts: expected
+  mesh axes per flattened argument leaf (from the mode's OWN spec
+  builder — ``zero_state_specs`` / ``pp_state_specs`` /
+  ``ep_state_specs``), the replication-drift pass's ground truth.
+- ``donate`` — whether the builder promises buffer donation (the
+  donation-audit pass verifies the jaxpr can actually alias it).
+- ``hlo`` — proof source: GSPMD modes (TP) lower their collectives in
+  the SPMD partitioner, so their inventory comes from compiled CPU HLO
+  instead of the jaxpr (see inventory.hlo_inventory).
+
+The clip variants exist for two reasons: they prove the axis-aware
+clip transforms deadlock-free (identical collective signatures on
+every rank) and they keep every collective call site in ``parallel/``
+reachable from a traced step — the dttlint DTT009 closure.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable
+
+#: the virtual mesh every scenario assumes (tests force the same one)
+N_DEVICES = 8
+
+
+def ensure_cpu_mesh() -> None:
+    """Force the 8-device virtual CPU mesh BEFORE jax initializes —
+    the conftest strategy, callable from the CLI and bench subprocess.
+    A no-op when jax is already up with >= 8 devices."""
+    import sys
+
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                        f"{N_DEVICES}").strip()
+    import jax
+
+    if len(jax.devices()) < N_DEVICES:
+        raise RuntimeError(
+            f"dttcheck needs a {N_DEVICES}-device mesh and jax is "
+            f"already initialized with {len(jax.devices())} device(s) — "
+            f"run in a fresh process (python -m tools.dttcheck) or "
+            f"under the test conftest")
+
+
+@dataclass
+class TraceTarget:
+    """Everything the passes need for one scenario, fully built."""
+
+    name: str
+    mode: str
+    model_name: str
+    step_fn: Callable
+    args: tuple
+    mesh: Any
+    model: Any
+    optimizer: Any
+    batch_size: int
+    ledger_kwargs: dict | None = None
+    plan: list | None = None          # expected axes per flat arg leaf
+    donate: bool = False
+    hlo: bool = False
+    notes: str = ""
+
+
+@dataclass
+class Scenario:
+    name: str
+    mode: str
+    model_name: str
+    build: Callable[[], TraceTarget]
+
+
+def _models():
+    from distributed_tensorflow_tpu.models.cnn import DeepCNN
+    from distributed_tensorflow_tpu.models.mlp import MLP
+    from distributed_tensorflow_tpu.models.transformer import TransformerLM
+    from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
+
+    return {
+        "deep_cnn": lambda **kw: DeepCNN(image_size=8, channels=1,
+                                         num_classes=10,
+                                         hidden_units=128, **kw),
+        "mlp": lambda **kw: MLP(image_size=8, channels=1, num_classes=10,
+                                hidden_units=64, **kw),
+        "lm": lambda **kw: TransformerLM(
+            vocab_size=64, seq_len=8, d_model=16, num_heads=2,
+            num_blocks=4, **kw),
+        "lm_moe": lambda **kw: TransformerLM(
+            vocab_size=64, seq_len=8, d_model=16, num_heads=2,
+            num_blocks=2, moe_experts=2, moe_axis=MODEL_AXIS, **kw),
+    }
+
+
+def make_batch(model, batch: int) -> tuple:
+    """A host batch with the model family's training shapes (zeros —
+    tracing reads avals only)."""
+    import numpy as np
+
+    if hasattr(model, "vocab_size"):  # the causal-LM family
+        return (np.zeros((batch, model.seq_len), np.int32),
+                np.zeros((batch, model.seq_len), np.int32))
+    flat = model.image_size * model.image_size * model.channels
+    return (np.zeros((batch, flat), np.float32),
+            np.zeros((batch, model.num_classes), np.float32))
+
+
+def _flat_axes(tree) -> list:
+    """Spec pytree -> expected mesh-axis tuple per flattened leaf."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    out = []
+    for spec in jax.tree.leaves(tree, is_leaf=lambda v: isinstance(v, P)):
+        axes = []
+        for entry in spec:
+            if entry is None:
+                continue
+            axes.extend(entry if isinstance(entry, tuple) else (entry,))
+        out.append(tuple(axes))
+    return out
+
+
+def _replicated_specs(tree):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(lambda _: P(), tree)
+
+
+def _mesh(data: int, model: int):
+    from distributed_tensorflow_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    return make_mesh(MeshSpec(data, model))
+
+
+def _opt():
+    from distributed_tensorflow_tpu.training.train_state import get_optimizer
+
+    return get_optimizer("sgd", 0.01)
+
+
+def _state(model, opt):
+    from distributed_tensorflow_tpu.training.train_state import (
+        create_train_state,
+    )
+
+    return create_train_state(model, opt, seed=0)
+
+
+# -------------------------------------------------- the generic builder
+
+
+def build_from_config(model, optimizer, batch_size: int, *,
+                      mode: str = "dp", data_ways: int = 1,
+                      model_axis: int = 1, zero_level: int = 0,
+                      virtual_stages: int = 1, microbatches: int = 0,
+                      pp_schedule: str = "auto",
+                      zero_overlap: bool = False,
+                      zero_bucket_mb: float = 4.0,
+                      grad_transform=None, name: str | None = None,
+                      model_name: str | None = None,
+                      **_ignored) -> TraceTarget:
+    """(model, optimizer, layout config) -> a traceable TraceTarget for
+    that mode's REAL train-step builder. The config keys mirror
+    ``utils/resources.parallel_config_from_flags`` exactly, so the
+    ``comm_ledger(verify=True)`` hook can forward its own kwargs
+    verbatim. ``grad_transform`` (the clip variants) disables the
+    ledger proof — clip collectives are deliberately unpriced."""
+    from jax.sharding import PartitionSpec as P  # noqa: F401
+
+    from distributed_tensorflow_tpu.parallel.mesh import (
+        DATA_AXIS,
+        MODEL_AXIS,
+    )
+
+    data_ways = max(1, int(data_ways))
+    model_axis = max(1, int(model_axis))
+    if mode.startswith("zero"):
+        zero_level = zero_level or int(mode[4:] or 0)
+    model_name = model_name or type(model).__name__
+    name = name or f"{mode}/{model_name}"
+    batch = make_batch(model, int(batch_size))
+    batch_axes = [(DATA_AXIS,), (DATA_AXIS,)]
+    ledger_kwargs = None if grad_transform is not None else dict(
+        mode=mode, data_ways=data_ways, model_axis=model_axis,
+        zero_level=zero_level, virtual_stages=virtual_stages,
+        microbatches=microbatches, pp_schedule=pp_schedule,
+        zero_overlap=zero_overlap, zero_bucket_mb=zero_bucket_mb)
+    common = dict(model=model, optimizer=optimizer, mode=mode,
+                  model_name=model_name, batch_size=int(batch_size),
+                  ledger_kwargs=ledger_kwargs, name=name)
+
+    if mode == "ps":
+        import jax
+
+        from distributed_tensorflow_tpu.parallel.ps_emulation import (
+            make_grad_fn,
+        )
+
+        grad_fn = make_grad_fn(model, keep_prob=1.0,
+                               devices=[jax.devices()[0]])
+        return TraceTarget(
+            step_fn=grad_fn,
+            args=(_state(model, optimizer).params, batch,
+                  jax.random.PRNGKey(0)),
+            mesh=None, plan=None, donate=False,
+            notes="host-wire topology: the device program must be "
+                  "collective-free (the pull/push rows ride TCP)",
+            **common)
+
+    mesh = _mesh(data_ways, model_axis)
+
+    if mode in ("zero1", "zero3"):
+        from distributed_tensorflow_tpu.parallel.zero import (
+            make_zero_train_step,
+            shard_state_zero,
+            zero_state_specs,
+        )
+
+        zstate = shard_state_zero(_state(model, optimizer), mesh,
+                                  zero_level)
+        step = make_zero_train_step(
+            model, optimizer, mesh, zero_level,
+            grad_transform=grad_transform, overlap=zero_overlap,
+            bucket_mb=zero_bucket_mb)
+        plan = _flat_axes(zero_state_specs(zstate, zero_level)) \
+            + batch_axes
+        return TraceTarget(step_fn=step, args=(zstate, batch), mesh=mesh,
+                           plan=plan, donate=True, **common)
+
+    if mode == "pp":
+        from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+            make_pp_train_step,
+            pp_state_specs,
+            shard_state_pp,
+        )
+
+        micro = int(microbatches) or model_axis
+        pstate = shard_state_pp(_state(model, optimizer), mesh,
+                                virtual_stages=virtual_stages)
+        step = make_pp_train_step(
+            model, optimizer, mesh, microbatches=micro,
+            grad_transform=grad_transform,
+            virtual_stages=virtual_stages, schedule=pp_schedule)
+        plan = _flat_axes(pp_state_specs(pstate)) + batch_axes
+        return TraceTarget(step_fn=step, args=(pstate, batch), mesh=mesh,
+                           plan=plan, donate=True, **common)
+
+    if mode == "tp":
+        from distributed_tensorflow_tpu.parallel.tensor_parallel import (
+            make_tp_train_step,
+            shard_state_tp,
+            stage_batch_tp,
+        )
+
+        state = shard_state_tp(_state(model, optimizer), mesh)
+        step = make_tp_train_step(model, optimizer, mesh,
+                                  grad_transform=grad_transform)
+        staged = stage_batch_tp(mesh, batch)
+        return TraceTarget(
+            step_fn=step, args=(state, staged), mesh=mesh, plan=None,
+            donate=True, hlo=True,
+            notes="GSPMD: inventory from compiled CPU HLO", **common)
+
+    if mode == "ep":
+        from distributed_tensorflow_tpu.parallel.expert_parallel import (
+            ep_state_specs,
+            make_ep_train_step,
+            shard_state_ep,
+        )
+
+        estate = shard_state_ep(_state(model, optimizer), mesh)
+        step = make_ep_train_step(model, optimizer, mesh,
+                                  grad_transform=grad_transform)
+        plan = _flat_axes(ep_state_specs(estate)) + batch_axes
+        return TraceTarget(step_fn=step, args=(estate, batch), mesh=mesh,
+                           plan=plan, donate=True, **common)
+
+    if mode == "sp":
+        from distributed_tensorflow_tpu.parallel.sequence_parallel import (
+            make_sp_train_step,
+        )
+
+        state = _state(model, optimizer)
+        step = make_sp_train_step(model, optimizer, mesh,
+                                  grad_transform=grad_transform,
+                                  per_token_targets=True)
+        plan = _flat_axes(_replicated_specs(state)) \
+            + [(DATA_AXIS, MODEL_AXIS), (DATA_AXIS, MODEL_AXIS)]
+        return TraceTarget(step_fn=step, args=(state, batch), mesh=mesh,
+                           plan=plan, donate=True, **common)
+
+    # dp (and the degenerate 1-chip local layout)
+    from distributed_tensorflow_tpu.parallel.data_parallel import (
+        make_dp_train_step,
+        replicate_state,
+    )
+
+    state = replicate_state(mesh, _state(model, optimizer))
+    step = make_dp_train_step(model, optimizer, mesh,
+                              grad_transform=grad_transform)
+    plan = _flat_axes(_replicated_specs(state)) + batch_axes
+    return TraceTarget(step_fn=step, args=(state, batch), mesh=mesh,
+                       plan=plan, donate=True, **common)
+
+
+# ------------------------------------------- eval / clip variant builders
+
+
+def _build_eval(mode: str, model_name: str) -> TraceTarget:
+    from distributed_tensorflow_tpu.parallel.mesh import (
+        DATA_AXIS,
+        MODEL_AXIS,
+    )
+
+    opt = _opt()
+    if mode == "dp":
+        from distributed_tensorflow_tpu.parallel.data_parallel import (
+            make_dp_eval_step,
+        )
+
+        model = _models()[model_name]()
+        mesh = _mesh(N_DEVICES, 1)
+        state = _state(model, opt)
+        step = make_dp_eval_step(model, mesh)
+        args = (state.params, make_batch(model, 8 * N_DEVICES),
+                state.model_state)
+        plan = _flat_axes(_replicated_specs(state.params)) \
+            + [(DATA_AXIS,), (DATA_AXIS,)] \
+            + _flat_axes(_replicated_specs(state.model_state))
+    elif mode == "zero3":
+        from distributed_tensorflow_tpu.parallel.zero import (
+            make_zero_eval_step,
+            shard_state_zero,
+        )
+
+        model = _models()[model_name]()
+        mesh = _mesh(N_DEVICES, 1)
+        zstate = shard_state_zero(_state(model, opt), mesh, 3)
+        step = make_zero_eval_step(model, mesh, 3)
+        args = (zstate.params, make_batch(model, 8 * N_DEVICES), ())
+        plan = [(DATA_AXIS,)] * len(_flat_axes(
+            _replicated_specs(zstate.params))) \
+            + [(DATA_AXIS,), (DATA_AXIS,)]
+    elif mode == "ep":
+        from distributed_tensorflow_tpu.parallel.expert_parallel import (
+            ep_state_specs,
+            make_ep_eval_step,
+            shard_state_ep,
+        )
+
+        model = _models()[model_name]()
+        mesh = _mesh(N_DEVICES // 2, 2)
+        estate = shard_state_ep(_state(model, opt), mesh)
+        step = make_ep_eval_step(model, mesh)
+        args = (estate.params, make_batch(model, 8 * (N_DEVICES // 2)))
+        plan = _flat_axes(ep_state_specs(estate).params) \
+            + [(DATA_AXIS,), (DATA_AXIS,)]
+    else:  # sp
+        from distributed_tensorflow_tpu.parallel.sequence_parallel import (
+            make_sp_eval_step,
+        )
+
+        model = _models()[model_name](seq_axis=MODEL_AXIS)
+        mesh = _mesh(N_DEVICES // 2, 2)
+        state = _state(model, opt)
+        step = make_sp_eval_step(model, mesh, per_token_targets=True)
+        args = (state.params, make_batch(model, 8 * (N_DEVICES // 2)), ())
+        plan = _flat_axes(_replicated_specs(state.params)) \
+            + [(DATA_AXIS, MODEL_AXIS), (DATA_AXIS, MODEL_AXIS)]
+    return TraceTarget(
+        name=f"{mode}_eval/{model_name}", mode=mode,
+        model_name=model_name, step_fn=step, args=args, mesh=mesh,
+        model=model, optimizer=opt, batch_size=int(args[1][0].shape[0]),
+        ledger_kwargs=None, plan=plan, donate=False)
+
+
+def _clip_transform(mode: str, virtual_stages: int = 1):
+    if mode == "pp":
+        from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+            pp_clip_transform,
+        )
+
+        return pp_clip_transform(1.0, virtual_stages)
+    if mode == "ep":
+        from distributed_tensorflow_tpu.parallel.expert_parallel import (
+            ep_clip_transform,
+        )
+
+        return ep_clip_transform(1.0)
+    from distributed_tensorflow_tpu.parallel.zero import (
+        zero_clip_transform,
+    )
+
+    return zero_clip_transform(1.0)
+
+
+def _canonical(mode: str, model_name: str, *, clip: bool = False,
+               **cfg) -> TraceTarget:
+    model = _models()[model_name]()
+    if mode == "sp":
+        from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
+
+        model = _models()[model_name](seq_axis=MODEL_AXIS)
+    data = cfg.pop("data_ways", N_DEVICES // cfg.get("model_axis", 1))
+    name = cfg.pop("name", None)
+    if clip and name is None:
+        name = f"{mode}_clip/{model_name}"
+    return build_from_config(
+        model, _opt(), cfg.pop("batch_size", 8 * data),
+        mode=mode, data_ways=data, name=name, model_name=model_name,
+        grad_transform=_clip_transform(
+            mode, cfg.get("virtual_stages", 1)) if clip else None,
+        **cfg)
+
+
+#: the matrix. Names are stable finding-key material; the full run is
+#: the repo gate, --mode/--model filter for bring-up.
+SCENARIOS: tuple = (
+    Scenario("dp/deep_cnn", "dp", "deep_cnn",
+             lambda: _canonical("dp", "deep_cnn")),
+    Scenario("dp/mlp", "dp", "mlp", lambda: _canonical("dp", "mlp")),
+    Scenario("dp_eval/deep_cnn", "dp", "deep_cnn",
+             lambda: _build_eval("dp", "deep_cnn")),
+    Scenario("zero1/deep_cnn", "zero1", "deep_cnn",
+             lambda: _canonical("zero1", "deep_cnn", zero_level=1)),
+    Scenario("zero1_overlap/deep_cnn", "zero1", "deep_cnn",
+             lambda: _canonical("zero1", "deep_cnn", zero_level=1,
+                                zero_overlap=True, zero_bucket_mb=0.25,
+                                name="zero1_overlap/deep_cnn")),
+    Scenario("zero3/deep_cnn", "zero3", "deep_cnn",
+             lambda: _canonical("zero3", "deep_cnn", zero_level=3)),
+    Scenario("zero3_overlap/deep_cnn", "zero3", "deep_cnn",
+             lambda: _canonical("zero3", "deep_cnn", zero_level=3,
+                                zero_overlap=True, zero_bucket_mb=0.25,
+                                name="zero3_overlap/deep_cnn")),
+    Scenario("zero1_clip/deep_cnn", "zero1", "deep_cnn",
+             lambda: _canonical("zero1", "deep_cnn", zero_level=1,
+                                clip=True)),
+    Scenario("zero3_eval/deep_cnn", "zero3", "deep_cnn",
+             lambda: _build_eval("zero3", "deep_cnn")),
+    Scenario("pp_gpipe/lm", "pp", "lm",
+             lambda: _canonical("pp", "lm", model_axis=2, microbatches=4,
+                                pp_schedule="gpipe",
+                                name="pp_gpipe/lm")),
+    Scenario("pp_interleaved/lm", "pp", "lm",
+             lambda: _canonical("pp", "lm", model_axis=2, microbatches=4,
+                                virtual_stages=2,
+                                pp_schedule="interleaved",
+                                name="pp_interleaved/lm")),
+    Scenario("pp_zb/lm", "pp", "lm",
+             lambda: _canonical("pp", "lm", model_axis=2, microbatches=4,
+                                pp_schedule="zb", name="pp_zb/lm")),
+    Scenario("pp_clip/lm", "pp", "lm",
+             lambda: _canonical("pp", "lm", model_axis=2, microbatches=4,
+                                pp_schedule="gpipe", clip=True,
+                                name="pp_clip/lm")),
+    Scenario("tp/deep_cnn", "tp", "deep_cnn",
+             lambda: _canonical("tp", "deep_cnn", model_axis=2)),
+    Scenario("ep/lm_moe", "ep", "lm_moe",
+             lambda: _canonical("ep", "lm_moe", model_axis=2)),
+    Scenario("ep_clip/lm_moe", "ep", "lm_moe",
+             lambda: _canonical("ep", "lm_moe", model_axis=2, clip=True,
+                                name="ep_clip/lm_moe")),
+    Scenario("ep_eval/lm_moe", "ep", "lm_moe",
+             lambda: _build_eval("ep", "lm_moe")),
+    Scenario("sp/lm", "sp", "lm",
+             lambda: _canonical("sp", "lm", model_axis=2)),
+    Scenario("sp_eval/lm", "sp", "lm", lambda: _build_eval("sp", "lm")),
+    Scenario("ps/deep_cnn", "ps", "deep_cnn",
+             lambda: _canonical("ps", "deep_cnn", data_ways=1,
+                                batch_size=32)),
+)

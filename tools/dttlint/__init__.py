@@ -35,6 +35,9 @@ docs/ARCHITECTURE.md "Static analysis"):
                            traced args) inside jit/shard_map/scan bodies
   DTT008 donation-safety   a donated argument is not read after the
                            donating call in the same scope
+  DTT009 traced-coverage   every parallel/ collective call site is
+                           reachable from a dttcheck-traced step
+                           function (the jaxpr layer's closure rule)
 
 Run it: ``python -m tools.dttlint [--json] [--baseline PATH] [--fix]``.
 Exit 0 = no non-baselined findings and no stale suppressions; nonzero
@@ -48,12 +51,24 @@ can only shrink.
 from __future__ import annotations
 
 import ast
-import json
 import os
-from dataclasses import dataclass, field
+import sys
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from tools._analysis_common import (  # noqa: E402 — the shared runner
+    REPO_ROOT,
+    AnalysisResult,
+    Finding,
+    apply_baseline,
+    load_baseline as _load_baseline,
+)
+
+# the historical names, kept for every existing caller (tests, bench):
+# dttlint's result type IS the shared analysis result
+LintResult = AnalysisResult
+
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
 
@@ -63,51 +78,6 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 LINT_TARGETS = ("distributed_tensorflow_tpu", "tools",
                 "bench.py", "__graft_entry__.py", "mnist_dist.py")
 SPAN_TAXONOMY_DOC = os.path.join("docs", "ARCHITECTURE.md")
-
-
-@dataclass
-class Finding:
-    """One rule violation. ``key`` is the STABLE identity (no line
-    numbers — lines churn, keys must survive unrelated edits) the
-    baseline suppresses by; ``path``/``line`` locate it for humans."""
-
-    rule: str
-    key: str
-    path: str
-    line: int
-    message: str
-    baselined: bool = False
-    # --fix support (DTT001): the literal to rewrite, when mechanical
-    fix: dict | None = None
-
-    def format(self) -> str:
-        tag = " [baselined]" if self.baselined else ""
-        return f"{self.path}:{self.line}: {self.rule} {self.message}{tag}"
-
-
-@dataclass
-class LintResult:
-    findings: list = field(default_factory=list)  # non-baselined
-    baselined: list = field(default_factory=list)
-    stale: list = field(default_factory=list)  # baseline keys w/o finding
-    rules: tuple = ()
-
-    @property
-    def ok(self) -> bool:
-        return not self.findings and not self.stale
-
-    def to_json(self) -> dict:
-        def row(f):
-            return {"rule": f.rule, "key": f.key, "path": f.path,
-                    "line": f.line, "message": f.message}
-
-        return {
-            "ok": self.ok,
-            "findings": [row(f) for f in self.findings],
-            "baselined": [row(f) for f in self.baselined],
-            "stale_suppressions": list(self.stale),
-            "rules": list(self.rules),
-        }
 
 
 class RepoIndex:
@@ -150,22 +120,14 @@ class RepoIndex:
 
 
 def load_baseline(path: str | None = None) -> list[dict]:
-    path = path or DEFAULT_BASELINE
-    if not os.path.exists(path):
-        return []
-    data = json.load(open(path, encoding="utf-8"))
-    entries = data.get("entries", [])
-    for e in entries:
-        if not {"rule", "key", "reason"} <= set(e):
-            raise ValueError(
-                f"baseline entry {e!r} must carry rule, key and reason "
-                f"(the reason IS the suppression's justification)")
-    return entries
+    return _load_baseline(path, DEFAULT_BASELINE)
 
 
 def run_lint(root: str = REPO_ROOT, baseline_path: str | None = None,
              rules=None, targets=LINT_TARGETS) -> LintResult:
-    """The one entry point (CLI, tier-1 test, bench lint_phase)."""
+    """The one entry point (CLI, tier-1 test, bench lint_phase).
+    Baseline matching and stale-suppression detection ride the shared
+    ``tools/_analysis_common`` machinery (dttcheck's too)."""
     from tools.dttlint.rules import ALL_RULES
 
     index = RepoIndex(root, targets)
@@ -173,21 +135,6 @@ def run_lint(root: str = REPO_ROOT, baseline_path: str | None = None,
     found: list[Finding] = list(index.errors)
     for rule in active:
         found.extend(rule(index))
-    entries = load_baseline(baseline_path)
-    by_key = {(e["rule"], e["key"]): e for e in entries}
-    result = LintResult(rules=tuple(
-        getattr(r, "rule_id", r.__name__) for r in active))
-    matched = set()
-    for f in sorted(found, key=lambda f: (f.path, f.line, f.rule)):
-        hit = by_key.get((f.rule, f.key))
-        if hit is not None:
-            f.baselined = True
-            matched.add((f.rule, f.key))
-            result.baselined.append(f)
-        else:
-            result.findings.append(f)
-    # stale suppressions fail loudly: the baseline can only shrink
-    checked_rules = set(result.rules)
-    result.stale = [f"{r}:{k}" for (r, k) in by_key
-                    if (r, k) not in matched and r in checked_rules]
-    return result
+    return apply_baseline(
+        found, load_baseline(baseline_path),
+        rules=tuple(getattr(r, "rule_id", r.__name__) for r in active))

@@ -802,6 +802,99 @@ def rule_donation_safety(index) -> list:
 rule_donation_safety.rule_id = "DTT008"
 
 
+# ---------------------------------------------- DTT009 traced-coverage
+
+
+#: the data-MOVING collectives DTT009 tracks (axis_index/axis_size are
+#: reads, not wire traffic — DTT001 still covers their axis argument)
+_DATA_COLLECTIVES = {"psum", "pmean", "psum_scatter", "all_gather",
+                     "ppermute", "all_to_all"}
+_DTTCHECK_PREFIX = "tools/dttcheck"
+
+
+def _identifiers(node) -> set:
+    """Every Name id and Attribute attr under ``node`` — the
+    conservative reference set (a function passed as a VALUE, e.g.
+    ``jax.tree.map(_gather_leaf, ...)``, counts as referenced)."""
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+    return out
+
+
+def rule_traced_coverage(index) -> list:
+    """DTT009: every ``parallel/`` collective call site must be
+    reachable from a dttcheck-traced step function — the AST and jaxpr
+    layers stay CLOSED UNDER EXTENSION: a new collective path that no
+    scenario traces is a comm path whose ledger bytes, deadlock
+    freedom, and donation story nobody has machine-proven (the r18
+    twin of DTT002's ledger-coverage rule). Reachability is
+    name-based and conservative: roots are every identifier
+    ``tools/dttcheck/`` mentions; edges are every identifier a
+    top-level ``parallel/`` function's body mentions (calls AND
+    values — builders pass helpers through ``jax.tree.map`` etc.)."""
+    roots: set = set()
+    has_dttcheck = False
+    for rel, tree in index.trees.items():
+        if rel.startswith(_DTTCHECK_PREFIX):
+            has_dttcheck = True
+            roots |= _identifiers(tree)
+    # keyed by (rel, name): reachability is name-based, but a function
+    # whose NAME collides with one in another parallel/ module must
+    # still contribute its own collective sites (a name-keyed dict
+    # would silently drop the second module's — a false negative)
+    funcs: dict = {}  # (rel, name) -> node
+    for rel, tree in index.trees.items():
+        if "/parallel/" not in f"/{rel}" or rel.endswith("__init__.py"):
+            continue
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                funcs[(rel, node.name)] = node
+    names = {name for _, name in funcs}
+    first_site: dict = {}   # (rel, name) -> first data-collective line
+    edges: dict = {}        # name -> union of referenced func names
+    for (rel, name), node in funcs.items():
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and _is_collective(sub) and \
+                    _callee(sub) in _DATA_COLLECTIVES:
+                first_site.setdefault((rel, name), sub.lineno)
+        edges[name] = edges.get(name, set()) | (
+            _identifiers(node) & names)
+    if not first_site:
+        return []  # no collective sites in scope (fixture slices)
+    if not has_dttcheck:
+        return [Finding(
+            "DTT009", "tools::dttcheck-missing", _DTTCHECK_PREFIX, 0,
+            "parallel/ contains collective call sites but no "
+            "tools/dttcheck/ sources are in the walk set — the "
+            "traced-coverage rule would silently self-disable")]
+    reachable = names & roots
+    stack = list(reachable)
+    while stack:
+        for callee in edges[stack.pop()]:
+            if callee not in reachable:
+                reachable.add(callee)
+                stack.append(callee)
+    out = []
+    for rel, name in sorted(first_site):
+        if name in reachable:
+            continue
+        out.append(Finding(
+            "DTT009", f"{rel}::{name}", rel, first_site[(rel, name)],
+            f"collective call site in {name}() is not reachable from "
+            f"any dttcheck-traced step function (tools/dttcheck "
+            f"references no path to it) — its wire bytes, deadlock "
+            f"freedom, and donation story are machine-unproven; add a "
+            f"scenario (or wire it into an existing traced builder)"))
+    return out
+
+
+rule_traced_coverage.rule_id = "DTT009"
+
+
 ALL_RULES = (
     rule_collective_axis,
     rule_ledger_coverage,
@@ -811,4 +904,5 @@ ALL_RULES = (
     rule_flag_validator,
     rule_trace_purity,
     rule_donation_safety,
+    rule_traced_coverage,
 )

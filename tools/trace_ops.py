@@ -43,12 +43,23 @@ ppermutes, TP/EP activation psums, SP ring hops — wire bytes per step,
 per mode, no chip. The --mem/--flops printers' third sibling: memory,
 compute, and now the wire.
 
-The static-analysis sibling of this whole printer family is
-``python -m tools.dttlint``: where --schedule/--mem/--flops/--comm
-PRINT the tree's static facts, dttlint ENFORCES its static invariants
-(collective axis constants, comm-ledger coverage, the loop scalar
-contract, fault/span/flag registries, trace purity, donation safety —
-rules DTT001-DTT008, docs/ARCHITECTURE.md "Static analysis").
+``--jaxpr MODEL D [--mode M] [--model_axis K] [--batch B]`` prints the
+TRACED collective inventory for one (mode, model) step function — the
+fourth sibling of --mem/--flops/--comm: memory, compute, the analytic
+wire, and now the wire AS LOWERED. The step is traced chip-free over
+the virtual CPU mesh (``tools/dttcheck``'s walker: ``jax.make_jaxpr``
++ a recursive equation walk with static trip counts; GSPMD modes read
+compiled CPU HLO), one row per collective equation with family, mesh
+axes, trips, and wire bytes — what the analytic ledger row SHOULD say,
+measured.
+
+The static-analysis siblings of this whole printer family are
+``python -m tools.dttlint`` (AST invariants, rules DTT001-DTT009) and
+``python -m tools.dttcheck`` (jaxpr-level proofs, passes DTC001-DTC004
+— the ledger/SPMD verifier whose inventory --jaxpr prints): where
+--schedule/--mem/--flops/--comm/--jaxpr PRINT the tree's static facts,
+those two ENFORCE them (docs/ARCHITECTURE.md "Static analysis" and
+"Jaxpr verification").
 
 Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
        python tools/trace_ops.py --schedule K M [V] [gpipe|interleaved|zb]
@@ -57,7 +68,10 @@ Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
        python tools/trace_ops.py --flops MODEL [BATCH]
        python tools/trace_ops.py --comm MODEL D [--model_axis K] [--batch B]
                                  [--zero_overlap] [--bucket_mb N]
+       python tools/trace_ops.py --jaxpr MODEL D [--mode M]
+                                 [--model_axis K] [--batch B]
        python -m tools.dttlint [--json] [--baseline PATH] [--fix]
+       python -m tools.dttcheck [--json] [--mode M] [--model M]
 """
 
 from __future__ import annotations
@@ -338,6 +352,71 @@ def print_comm(model_name: str, d: int, model_axis: int = 2,
             print("  (no collectives — single-chip layout)")
 
 
+def print_jaxpr_inventory(model_name: str, d: int, mode: str = "dp",
+                          model_axis: int = 2,
+                          batch: int = 128) -> None:
+    """Print the traced per-step collective inventory for one
+    (mode, model) cell — the same walker behind ``python -m
+    tools.dttcheck``'s ledger proof, so what prints here IS what the
+    proof measured. Chip-free: the step traces over the virtual
+    8-device CPU mesh (forced before jax initializes, the conftest
+    strategy); GSPMD modes (tp) compile tiny CPU HLO instead."""
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.dttcheck.scenarios import ensure_cpu_mesh
+
+    ensure_cpu_mesh()
+    from distributed_tensorflow_tpu.models import get_model
+    from distributed_tensorflow_tpu.training import get_optimizer
+    from tools.dttcheck.inventory import hlo_inventory, trace_inventory
+    from tools.dttcheck.scenarios import build_from_config
+
+    if model_name not in _MEM_MODELS:
+        raise SystemExit(f"--jaxpr: unknown model {model_name!r}; "
+                         f"available: {sorted(_MEM_MODELS)}")
+    known = ("dp", "zero1", "zero3", "pp", "tp", "ep", "sp", "ps")
+    if mode not in known:
+        raise SystemExit(f"--jaxpr: unknown mode {mode!r}; one of "
+                         f"{', '.join(known)}")
+    kw = _MEM_MODELS[model_name]
+    if mode == "sp":
+        from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
+
+        kw = dict(kw, seq_axis=MODEL_AXIS)
+    model = get_model(model_name, **kw)
+    model_ways = model_axis if mode in ("pp", "tp", "ep", "sp") else 1
+    target = build_from_config(
+        model, get_optimizer("adam", 1e-3), batch,
+        mode=mode, data_ways=max(1, d // model_ways),
+        model_axis=model_ways,
+        zero_level=int(mode[4:]) if mode.startswith("zero") else 0,
+        model_name=model_name)
+    _, inv = trace_inventory(target.step_fn, target.args)
+    if target.hlo:
+        compiled = target.step_fn.lower(*target.args).compile()
+        inv = hlo_inventory(compiled.as_text(), target.mesh)
+    print(f"traced collective inventory — model={model_name} "
+          f"mode={mode} D={d} batch={batch} "
+          f"(source: {'compiled CPU HLO' if target.hlo else 'jaxpr'}; "
+          f"wire conventions: all-reduce 2x, reduce-scatter in, "
+          f"all-gather out, ppermute payload)")
+    print(f"{'family':<16} {'axes':<14} {'trips':>6} {'payload':>12} "
+          f"{'wire bytes':>12}  site")
+    for e in sorted(inv.priced(), key=lambda e: -e.wire_bytes):
+        print(f"{e.family:<16} {','.join(e.axes):<14} {e.trips:>6} "
+              f"{_fmt_bytes(e.payload_bytes):>12} "
+              f"{_fmt_bytes(e.wire_bytes):>12}  {e.site}")
+    ctrl = inv.control()
+    print(f"\ntotal: {len(inv.priced())} priced collective(s), "
+          f"{_fmt_bytes(inv.total_bytes())}/step on the wire; "
+          f"{len(ctrl)} control-plane (scalar metrics / rng) exempt")
+    for key, bytes_ in sorted(inv.grouped().items()):
+        fam, axes = key
+        print(f"  {fam} over {','.join(axes)}: {_fmt_bytes(bytes_)}")
+
+
 def print_faults() -> None:
     """List the fault-injection registry (the --fault_spec grammar's
     source of truth — utils/faults.INJECTION_POINTS)."""
@@ -382,6 +461,26 @@ if __name__ == "__main__":
     elif sys.argv[1] == "--flops":
         print_flops(sys.argv[2],
                     int(sys.argv[3]) if len(sys.argv) > 3 else 128)
+    elif sys.argv[1] == "--jaxpr":
+        rest = sys.argv[2:]
+        mode = "dp"
+        model_axis = 2
+        batch = 128
+        if "--mode" in rest:
+            i = rest.index("--mode")
+            mode = rest[i + 1]
+            rest = rest[:i] + rest[i + 2:]
+        if "--model_axis" in rest:
+            i = rest.index("--model_axis")
+            model_axis = int(rest[i + 1])
+            rest = rest[:i] + rest[i + 2:]
+        if "--batch" in rest:
+            i = rest.index("--batch")
+            batch = int(rest[i + 1])
+            rest = rest[:i] + rest[i + 2:]
+        print_jaxpr_inventory(rest[0],
+                              int(rest[1]) if len(rest) > 1 else 8,
+                              mode, model_axis, batch)
     elif sys.argv[1] == "--comm":
         rest = sys.argv[2:]
         model_axis = 2
