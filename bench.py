@@ -984,9 +984,12 @@ def telemetry_ab_phase(ds, n_chips) -> dict:
     CompileSentry signature note per chunk) vs OFF (bare dispatch),
     same compiled executable.
     ``telemetry_overhead_pct`` is the acceptance number (< 2% required
-    — now covering the full armed observability stack); the ON arm's
-    StepTimer also yields the MEASURED step-time breakdown for the
-    flagship CNN, replacing the host-only phase's synthetic facts."""
+    — now covering the full armed observability stack, PLUS the r19
+    request plane: one request record begun/finished through an armed
+    RequestPlane per chunk — audit ring, tail histograms, SLO ledger,
+    and the req:* span emission); the ON arm's StepTimer also yields
+    the MEASURED step-time breakdown for the flagship CNN, replacing
+    the host-only phase's synthetic facts."""
     try:
         from distributed_tensorflow_tpu.data.device_data import (
             put_device_data,
@@ -995,6 +998,7 @@ def telemetry_ab_phase(ds, n_chips) -> dict:
         from distributed_tensorflow_tpu.parallel.data_parallel import (
             replicate_state,
         )
+        from distributed_tensorflow_tpu.serving import reqtrace
         from distributed_tensorflow_tpu.training import (
             adam,
             create_train_state,
@@ -1034,6 +1038,12 @@ def telemetry_ab_phase(ds, n_chips) -> dict:
                 # note per chunk
                 mm = resources.MemoryMeter() if arm == "on" else None
                 cs = resources.CompileSentry() if arm == "on" else None
+                # the r19 request plane pays its per-request cost in
+                # the ON arm too (built outside the timed window; the
+                # per-chunk begin/finish below is the armed record)
+                rplane = (reqtrace.RequestPlane(ring=256, exemplars=3,
+                                                slo_p99_ms=1000.0)
+                          if arm == "on" else None)
                 state = create_train_state(model, opt, seed=0)
                 if mesh is not None:
                     state = replicate_state(mesh, state)
@@ -1061,6 +1071,17 @@ def telemetry_ab_phase(ds, n_chips) -> dict:
                         snt.observe(c * CHUNK, {"loss": 1.0 + 1e-3 * c})
                         mm.scalars()
                         cs.observe("device_chunk", (CHUNK,))
+                        # one armed request-plane record: trace begin,
+                        # lifecycle marks, finish (audit + tail hists
+                        # + SLO observe + req:* span emission)
+                        tr = rplane.begin(reqtrace.new_request_id(),
+                                          "bench", CHUNK)
+                        tr.admitted()
+                        tr.taken()
+                        tr.run_start()
+                        tr.note("prefill", 0.0)
+                        tr.run_end()
+                        rplane.finish(tr, "ok")
                     else:
                         state, m = chunk_fn(state, data)
                     if sync_every and (c * CHUNK) % sync_every < CHUNK:
@@ -1096,6 +1117,150 @@ def telemetry_ab_phase(ds, n_chips) -> dict:
                 "telemetry_off_images_per_sec_per_chip": None,
                 "telemetry_on_images_per_sec_per_chip": None,
                 "telemetry_ab_error": f"{type(e).__name__}: {e}"[:200]}
+
+
+# r19: the request-plane drill — host-only like the serving drill (the
+# real engine/batcher/client with serving/reqtrace armed, no chip), so
+# the per-request observability facts survive tunnel outages. The
+# closed-loop loadgen drives REQTRACE_REQUESTS requests through the
+# plane and the record asserts 100% of them reconstruct a complete
+# phase timeline. Overhead is measured DETERMINISTICALLY: the plane's
+# per-request cost (begin + lifecycle marks + finish with audit/tail/
+# SLO/span emission, amortized over a tight loop) as a percent of the
+# drill's measured mean request latency — a thread-scheduling-noisy
+# on/off closed-loop A/B cannot resolve a cost this small. The <2%
+# end-to-end acceptance number is telemetry_ab_phase's, whose ON arm
+# pays the same per-record cost.
+REQTRACE_REQUESTS = 200
+REQTRACE_SLO_P99_MS = 250.0
+REQTRACE_COST_SAMPLES = 2000
+
+_REQTRACE_NULLS = {
+    "reqtrace_requests_total": None,
+    "reqtrace_complete_pct": None,
+    "reqtrace_p99_phase": None,
+    "reqtrace_slo_compliant_pct": None,
+    "reqtrace_record_cost_ms": None,
+    "reqtrace_overhead_pct": None,
+}
+
+
+def reqtrace_phase() -> dict:
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        save_checkpoint,
+    )
+    from distributed_tensorflow_tpu.serving import reqtrace
+    from distributed_tensorflow_tpu.serving.batcher import DynamicBatcher
+    from distributed_tensorflow_tpu.serving.engine import InferenceEngine
+    from distributed_tensorflow_tpu.serving.server import (
+        InProcessClient,
+        make_predict_runner,
+        predict_group_key,
+    )
+    from distributed_tensorflow_tpu.utils import telemetry
+    from tools.serve_loadgen import run_closed_loop
+
+    d = tempfile.mkdtemp(prefix="bench-reqtrace-")
+    prev_plane = reqtrace.get_plane()
+    tracer = telemetry.get_tracer()
+    prev_enabled = tracer.enabled
+    batchers = []
+    try:
+        rng = np.random.default_rng(0)
+        params = {"w": rng.standard_normal((64, 16)).astype(np.float32),
+                  "b": np.zeros(16, np.float32)}
+        save_checkpoint(d, {"params": params}, 10)
+        engine = InferenceEngine(_ServeBenchModel(), d, jit=False,
+                                 params_template=params, max_batch=8)
+        x = rng.standard_normal(64).astype(np.float32)
+
+        tracer.enabled = True
+        plane = reqtrace.configure(enabled=True,
+                                   ring=REQTRACE_REQUESTS + 64,
+                                   slo_p99_ms=REQTRACE_SLO_P99_MS)
+        # the serving DEFAULT batching delay (5 ms): the overhead
+        # denominator must be a default-configured request's latency,
+        # not an artificially tightened one
+        batcher = DynamicBatcher(make_predict_runner(engine),
+                                 max_batch=8, max_delay_ms=5.0,
+                                 queue_depth=64,
+                                 group_key=predict_group_key,
+                                 name="predict")
+        batchers.append(batcher)
+        client = InProcessClient(predict_batcher=batcher)
+
+        def request():
+            _out, meta = client.predict_ex(x)
+            return meta
+
+        rep = run_closed_loop(request,
+                              n_requests=REQTRACE_REQUESTS,
+                              concurrency=SERVE_BENCH_CONCURRENCY,
+                              slo_p99_ms=REQTRACE_SLO_P99_MS)
+        batcher.close(drain=False)
+        assert rep["ok"] == REQTRACE_REQUESTS and rep["errors"] == 0, rep
+        audit = list(plane.audit)
+        need = {"admit", "queue_wait", "batch_assembly", "prefill",
+                "respond"}
+        complete = [s for s in audit if s["disposition"] == "ok"
+                    and need <= set(s["phases_ms"])]
+        complete_pct = 100.0 * len(complete) / max(len(audit), 1)
+        assert len(audit) == REQTRACE_REQUESTS \
+            and complete_pct == 100.0, (
+            f"{len(complete)}/{len(audit)} of {REQTRACE_REQUESTS} "
+            f"requests reconstruct a complete phase timeline — the "
+            f"request plane dropped records")
+        tail = plane.tail_report()
+        slo = plane.slo_report()
+        # per-request plane cost, amortized (a throwaway plane with the
+        # drill's config so the synthetic records don't pollute the
+        # audit facts above), over the drill's measured mean latency
+        cost_plane = reqtrace.RequestPlane(
+            ring=64, slo_p99_ms=REQTRACE_SLO_P99_MS)
+        t0 = time.perf_counter()
+        for _ in range(REQTRACE_COST_SAMPLES):
+            tr = cost_plane.begin(reqtrace.new_request_id(),
+                                  "predict", x)
+            tr.admitted()
+            tr.taken()
+            tr.run_start()
+            tr.note("prefill", 0.0)
+            tr.run_end()
+            cost_plane.finish(tr, "ok")
+        cost_ms = ((time.perf_counter() - t0)
+                   / REQTRACE_COST_SAMPLES * 1e3)
+        mean_ms = rep["latency_ms_mean"]
+        overhead = (100.0 * cost_ms / mean_ms if mean_ms > 0 else None)
+        assert overhead is not None and overhead < 2.0, (
+            f"armed request plane costs {cost_ms:.4f} ms/request = "
+            f"{overhead:.2f}% of the {mean_ms:.2f} ms mean request — "
+            f"blows the 2% observability budget")
+        return {
+            "reqtrace_requests_total": len(audit),
+            "reqtrace_complete_pct": round(complete_pct, 2),
+            "reqtrace_p99_phase":
+                tail["exemplars"][0]["dominant_phase"],
+            "reqtrace_slo_compliant_pct": slo["compliant_pct"],
+            "reqtrace_record_cost_ms": round(cost_ms, 5),
+            "reqtrace_overhead_pct": (None if overhead is None
+                                      else round(overhead, 3)),
+        }
+    except Exception as e:  # never kill the record over the drill
+        return {**_REQTRACE_NULLS,
+                "reqtrace_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        for b in batchers:
+            b.close(drain=False)
+        # restore whatever plane the process had (the serving replica's
+        # configured one in production; None in the test suite)
+        reqtrace._PLANE = prev_plane
+        tracer.enabled = prev_enabled
+        shutil.rmtree(d, ignore_errors=True)
 
 
 # r12: the efficiency phase — MFU / model-FLOPs / goodput accounting
@@ -2023,6 +2188,9 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
     # and its overhead_pct stays null here)
     out.update(recovery_phase())
     out.update(serving_phase())
+    # r19: the request-plane drill rides the same host-only contract —
+    # reqtrace_* facts stay non-null in EVERY record incl. outages
+    out.update(reqtrace_phase())
     out.update(telemetry_phase())
     if cpu_smoke:
         # flips this process to the CPU backend (legal only in the
@@ -2152,6 +2320,10 @@ def _run_phases(out: dict):
     # r9: the serving drill (host-only for the same reason) — offered
     # load through the real engine/batcher/hot-reload machinery
     out.update(serving_phase())
+    # r19: the request-plane drill (host-only) — per-request phase
+    # timelines, tail attribution, and SLO compliance through the
+    # armed plane, with the on-vs-off serving A/B
+    out.update(reqtrace_phase())
     # r11: telemetry — host-only span-overhead/breakdown drill, then
     # the chip A/B (telemetry on vs off on the flagship chunk loop)
     # overwriting the synthetic breakdown with the measured one

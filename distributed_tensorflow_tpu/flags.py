@@ -647,7 +647,33 @@ def define_serving_flags():
                  "it mid-request. 0 = off. Only meaningful where the "
                  "backend reports a memory limit (headroom reads -1 "
                  "elsewhere and never trips the floor)")
+    DEFINE_float("slo_p99_ms", 0.0, "Serving latency SLO (the request "
+                 "plane, serving/reqtrace.py): a request is compliant "
+                 "when it completes ok within this many milliseconds. "
+                 "Arms the error-budget ledger — the /metrics slo "
+                 "block (compliant_pct, budget_remaining, fast/slow "
+                 "burn rates) and the /healthz 503 on a fast-burn "
+                 "breach (joining the HBM-headroom drain floor). "
+                 "0 = SLO accounting off (phase timelines and tail "
+                 "attribution still run)")
+    DEFINE_float("slo_target_pct", 99.0, "The SLO compliance target: "
+                 "this percent of requests are promised within "
+                 "--slo_p99_ms; the remainder is the error budget the "
+                 "burn rates are measured against. Must be in "
+                 "(50, 100]; only meaningful with --slo_p99_ms > 0")
+    DEFINE_integer("reqtrace_ring", _REQTRACE_RING_DEFAULT,
+                   "Bounded per-request audit "
+                   "ring (the request plane): how many finished "
+                   "request summaries — id, route, shape-bucket, "
+                   "disposition, phase breakdown — the replica retains "
+                   "for the /metrics tail exemplars and postmortems")
+    DEFINE_integer("reqtrace_exemplars", _REQTRACE_EXEMPLARS_DEFAULT,
+                   "How many worst live "
+                   "exemplars (request_id + phase breakdown, by total "
+                   "latency) the /metrics tail block names; must be "
+                   "in [1, 64]")
     FLAGS._register_validator(_validate_serving_flags)
+    FLAGS._register_validator(_validate_reqtrace_flags)
 
 
 def _require(values: dict, name: str, check, what: str):
@@ -1072,6 +1098,72 @@ def _validate_resource_flags(values: dict):
             "--hbm_sample_every > 1 with --telemetry=false is silently "
             "inert (HBM sampling rides the telemetry spine; "
             "--telemetry=false already disables it) — drop one")
+
+
+# the request plane's flag defaults, shared by the DEFINE_* calls and
+# the telemetry=false armed-deviation checks below so they cannot
+# drift (a retuned default must not start rejecting plain
+# --telemetry=false invocations)
+_REQTRACE_RING_DEFAULT = 512
+_REQTRACE_EXEMPLARS_DEFAULT = 5
+
+
+def _validate_reqtrace_flags(values: dict):
+    """Parse-time validation of the request-plane surface (the PR-2
+    _register_validator pattern): out-of-bounds --slo_*/--reqtrace_*
+    values, an SLO target without the SLO armed, or request-plane
+    knobs explicitly armed under --telemetry=false (the plane rides
+    the telemetry spine and would be silently inert — the DTT006
+    armed-deviation rule), all surface at the command line with the
+    bounds named."""
+    p99 = values.get("slo_p99_ms")
+    if p99 is not None and float(p99) < 0:
+        raise ValueError(f"--slo_p99_ms={p99} must be >= 0 ms "
+                         f"(0 = SLO accounting off)")
+    tgt = values.get("slo_target_pct")
+    if tgt is not None and not (50.0 < float(tgt) <= 100.0):
+        raise ValueError(f"--slo_target_pct={tgt} must be in (50, 100] "
+                         f"(the promised compliant fraction; <= 50 "
+                         f"leaves no meaningful error budget)")
+    if tgt is not None and float(tgt) != 99.0 \
+            and (p99 is None or float(p99) <= 0):
+        raise ValueError(
+            "--slo_target_pct without --slo_p99_ms > 0 is silently "
+            "inert (the target only parameterizes the armed "
+            "error-budget ledger) — set --slo_p99_ms or drop the "
+            "target")
+    ring = values.get("reqtrace_ring")
+    if ring is not None and not (16 <= int(ring) <= 1_048_576):
+        raise ValueError(f"--reqtrace_ring={ring} must be in "
+                         f"[16, 1048576] retained request summaries")
+    ex = values.get("reqtrace_exemplars")
+    if ex is not None and not (1 <= int(ex) <= 64):
+        raise ValueError(f"--reqtrace_exemplars={ex} must be in "
+                         f"[1, 64] named tail exemplars")
+    telemetry_flag = values.get("telemetry")
+    if telemetry_flag is None or telemetry_flag:
+        return
+    # telemetry off: reject explicitly-armed request-plane knobs (the
+    # watchdog_s precedent — defaults pass, deviations in the armed
+    # direction are silently inert and must be named)
+    if p99 is not None and float(p99) > 0:
+        raise ValueError(
+            "--slo_p99_ms > 0 with --telemetry=false is silently inert "
+            "(the request plane's ledger, audit ring, and req:* spans "
+            "ride the telemetry spine) — drop it or re-enable "
+            "--telemetry")
+    if ring is not None and int(ring) != _REQTRACE_RING_DEFAULT:
+        raise ValueError(
+            "--reqtrace_ring with --telemetry=false is silently inert "
+            "(the audit ring is part of the request plane, which "
+            "--telemetry=false leaves unconfigured) — drop it or "
+            "re-enable --telemetry")
+    if ex is not None and int(ex) != _REQTRACE_EXEMPLARS_DEFAULT:
+        raise ValueError(
+            "--reqtrace_exemplars with --telemetry=false is silently "
+            "inert (the tail block is part of the request plane, which "
+            "--telemetry=false leaves unconfigured) — drop it or "
+            "re-enable --telemetry")
 
 
 def _validate_elastic_flags(values: dict):

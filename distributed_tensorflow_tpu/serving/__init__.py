@@ -5,16 +5,24 @@ into answered requests: ``engine`` (restore-with-fallback + placement +
 per-bucket jitted apply + hot-reload), ``batcher`` (dynamic microbatch
 assembly with deadline-aware admission), ``decode`` (KV-cache
 autoregressive decode, bitwise-consistent with full recompute),
-``server`` (stdlib JSON-over-HTTP + in-process client). Run it:
+``server`` (stdlib JSON-over-HTTP + in-process client), ``reqtrace``
+(the request plane: per-request phase timelines, tail attribution, SLO
+accounting). Run it:
 
     python -m distributed_tensorflow_tpu.serving --logdir /tmp/train_logs
 """
 
+from distributed_tensorflow_tpu.serving import reqtrace
 from distributed_tensorflow_tpu.serving.batcher import (
     DynamicBatcher,
     Future,
     RejectedError,
     pow2_bucket,
+)
+from distributed_tensorflow_tpu.serving.reqtrace import (
+    RequestPlane,
+    SLOLedger,
+    new_request_id,
 )
 from distributed_tensorflow_tpu.serving.engine import (
     CheckpointWatcher,
@@ -40,10 +48,14 @@ __all__ = [
     "InProcessClient",
     "NoCheckpointError",
     "RejectedError",
+    "RequestPlane",
+    "SLOLedger",
     "ServingMetrics",
     "generate_group_key",
     "make_generate_runner",
     "make_predict_runner",
+    "new_request_id",
     "pow2_bucket",
     "predict_group_key",
+    "reqtrace",
 ]

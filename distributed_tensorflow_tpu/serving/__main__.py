@@ -61,6 +61,12 @@ def build_serving_stack(FLAGS):
     # job_name="serve": a replica pointed at the trainer's live logdir
     # must not collide with the trainer's spans/flightrec files
     telemetry.configure_from_flags(FLAGS, job_name="serve")
+    # the request plane (r19) rides the same spine: per-request phase
+    # timelines into spans-serve-N.jsonl, the audit ring behind the
+    # /metrics tail block, and the --slo_* error-budget ledger
+    from distributed_tensorflow_tpu.serving import reqtrace
+
+    reqtrace.configure_from_flags(FLAGS)
     model = build_model_for(FLAGS, _dataset_meta(FLAGS))
 
     mesh = None
@@ -160,6 +166,12 @@ def main(argv):
             if b is not None:
                 b.close(drain=False)
         server.close()
+        # shutdown is the last guaranteed flush point: a short-lived
+        # replica (fewer batches than the flush cadence) must not lose
+        # its spans — the request plane's req:* records included
+        from distributed_tensorflow_tpu.utils import telemetry
+
+        telemetry.get_tracer().flush()
     return 0
 
 

@@ -30,6 +30,16 @@ Fault points (utils/faults.py): ``serve_admit`` fires inside submit
 after the admission checks, ``serve_batch`` after a microbatch is
 assembled — ``--fault_spec serve_batch:mode=error`` proves the
 reject-with-reason path under deterministic failure.
+
+Request plane (r19, serving/reqtrace.py): every submission mints (or
+echoes) a ``request_id`` and — when the plane is configured — owns a
+phase timeline (admit/queue_wait/batch_assembly/prefill/decode/respond)
+with a terminal disposition. EVERY exit records one: completions "ok",
+a full queue "rejected_full", a closed batcher "rejected_closed", an
+injected admission fault "rejected_fault", a deadline "expired", a
+failed batch or dead worker "failed" — rejections no longer vanish from
+the per-request story, and ``RejectedError.request_id`` carries the id
+to the wire.
 """
 
 from __future__ import annotations
@@ -39,6 +49,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from distributed_tensorflow_tpu.serving import reqtrace
 from distributed_tensorflow_tpu.utils import telemetry
 from distributed_tensorflow_tpu.utils.faults import fault_point
 from distributed_tensorflow_tpu.utils.telemetry import trace_span
@@ -48,22 +59,29 @@ class RejectedError(RuntimeError):
     """A request the serving stack declined to run, with the reason
     (queue full, deadline exceeded, batcher closed, injected fault).
     Backpressure is a VISIBLE contract: callers get this immediately,
-    never a hang."""
+    never a hang. ``request_id`` names the rejected request so the
+    refusal is correlatable with the audit ring and span sink."""
 
-    def __init__(self, reason: str):
+    def __init__(self, reason: str, request_id: str | None = None):
         super().__init__(reason)
         self.reason = reason
+        self.request_id = request_id
 
 
 class Future:
-    """Single-assignment result slot for one request."""
+    """Single-assignment result slot for one request. ``request_id``
+    is set at submit; ``meta`` (the request-plane summary: disposition,
+    phase breakdown) is set — before the result — when the plane is
+    configured."""
 
-    __slots__ = ("_event", "_value", "_error")
+    __slots__ = ("_event", "_value", "_error", "request_id", "meta")
 
     def __init__(self):
         self._event = threading.Event()
         self._value = None
         self._error: BaseException | None = None
+        self.request_id: str | None = None
+        self.meta: dict | None = None
 
     def set_result(self, value) -> None:
         self._value = value
@@ -92,18 +110,18 @@ class _Request:
     future: Future
     t_submit: float
     deadline: float
+    request_id: str = ""
+    trace: Any = None  # reqtrace.RequestTrace | None
 
 
 def pow2_bucket(n: int, cap: int) -> int:
     """The smallest power of two >= n, clamped to ``cap`` — the batch
     padding policy (one compiled executable per bucket instead of one
-    per observed batch size)."""
+    per observed batch size). The rounding rule itself is shared with
+    the request plane's shape buckets (``reqtrace.pow2_ceil``)."""
     if n < 1:
         raise ValueError(f"bucket of {n} requests")
-    b = 1
-    while b < n:
-        b <<= 1
-    return min(b, cap)
+    return min(reqtrace.pow2_ceil(n), cap)
 
 
 @dataclass
@@ -164,6 +182,7 @@ class DynamicBatcher:
         self._group_key = group_key
         self.latency = latency
         self._on_batch = on_batch
+        self._route = name  # the request plane's route key
         self.stats = BatcherStats()
         self._queue: list[_Request] = []
         self._cv = threading.Condition()
@@ -181,18 +200,27 @@ class DynamicBatcher:
     # ------------------------------------------------------- admission
 
     def submit(self, payload, timeout_ms: float | None = None,
-               **opts) -> Future:
+               request_id: str | None = None, **opts) -> Future:
         """Admit one request; returns its Future. Raises
         ``RejectedError`` IMMEDIATELY on a full queue, a closed batcher,
-        or an armed ``serve_admit`` fault — admission never blocks."""
+        or an armed ``serve_admit`` fault — admission never blocks.
+        ``request_id`` (client-supplied) is echoed everywhere the
+        request appears; omitted, one is minted — either way the Future
+        (and any RejectedError) carries it."""
         now = time.monotonic()
+        rid = str(request_id) if request_id else reqtrace.new_request_id()
+        plane = reqtrace.get_plane()
+        tr = (plane.begin(rid, self._route, payload)
+              if plane is not None else None)
         timeout_s = (self.default_timeout_s if timeout_ms is None
                      else float(timeout_ms) / 1000.0)
         group = (self._group_key(payload, opts)
                  if self._group_key is not None else None)
         req = _Request(payload=payload, opts=opts, group=group,
                        future=Future(), t_submit=now,
-                       deadline=now + timeout_s)
+                       deadline=now + timeout_s, request_id=rid,
+                       trace=tr)
+        req.future.request_id = rid
         with self._cv:
             if self._closed:
                 # distinct counter: a closed batcher needs a restart, a
@@ -200,19 +228,28 @@ class DynamicBatcher:
                 # to tell which from the stats
                 with self.stats.lock:
                     self.stats.rejected_closed += 1
-                raise RejectedError("batcher closed")
+                reqtrace.finish(tr, "rejected_closed",
+                                reason="batcher closed")
+                raise RejectedError("batcher closed", request_id=rid)
             if len(self._queue) >= self.queue_depth:
                 with self.stats.lock:
                     self.stats.rejected_full += 1
-                raise RejectedError(
-                    f"queue full (depth={self.queue_depth}); retry later")
+                reason = (f"queue full (depth={self.queue_depth}); "
+                          f"retry later")
+                reqtrace.finish(tr, "rejected_full", reason=reason)
+                raise RejectedError(reason, request_id=rid)
             try:
                 fault_point("serve_admit", count=self.stats.admitted + 1)
             except Exception as e:
                 with self.stats.lock:
                     self.stats.rejected_fault += 1
-                raise RejectedError(f"admission fault: {e}") from e
+                reqtrace.finish(tr, "rejected_fault",
+                                reason=f"admission fault: {e}")
+                raise RejectedError(f"admission fault: {e}",
+                                    request_id=rid) from e
             self._queue.append(req)
+            if tr is not None:
+                tr.admitted()
             with self.stats.lock:
                 self.stats.admitted += 1
                 self.stats.queue_depth = len(self._queue)
@@ -240,6 +277,9 @@ class DynamicBatcher:
                         taken = set(map(id, batch))
                         self._queue = [r for r in self._queue
                                        if id(r) not in taken]
+                        for r in batch:
+                            if r.trace is not None:
+                                r.trace.taken()
                         with self.stats.lock:
                             self.stats.queue_depth = len(self._queue)
                         return batch
@@ -254,8 +294,12 @@ class DynamicBatcher:
             if r.deadline <= now:
                 with self.stats.lock:
                     self.stats.rejected_deadline += 1
+                r.future.meta = reqtrace.finish(
+                    r.trace, "expired",
+                    reason="deadline exceeded before execution")
                 r.future.set_error(RejectedError(
-                    "deadline exceeded before execution"))
+                    "deadline exceeded before execution",
+                    request_id=r.request_id))
             else:
                 keep.append(r)
         if len(keep) != len(self._queue):
@@ -294,7 +338,9 @@ class DynamicBatcher:
                 with trace_span("serve_batch", count=n_batch,
                                 size=len(batch)), \
                         telemetry.armed("serve_batch", count=n_batch,
-                                        size=len(batch)):
+                                        size=len(batch)), \
+                        reqtrace.batch_context(
+                            [r.trace for r in batch]):
                     results = self._runner([r.payload for r in batch],
                                            [r.opts for r in batch])
                 if len(results) != len(batch):
@@ -305,6 +351,9 @@ class DynamicBatcher:
                 for r, res in zip(batch, results):
                     if self.latency is not None:
                         self.latency.record((now - r.t_submit) * 1e3)
+                    # meta BEFORE the result: a client reading the
+                    # future right after result() must see the summary
+                    r.future.meta = reqtrace.finish(r.trace, "ok")
                     r.future.set_result(res)
                 with self.stats.lock:
                     self.stats.completed += len(batch)
@@ -320,12 +369,19 @@ class DynamicBatcher:
                     self.stats.failed += len(batch)
                 for r in batch:
                     if not r.future.done():
+                        r.future.meta = reqtrace.finish(
+                            r.trace, "failed",
+                            reason=f"{type(e).__name__}: {e}")
                         r.future.set_error(e)
             except BaseException as e:
                 # worker death (SystemExit and friends): fail the batch
                 # AND everything pending, close — no client ever hangs
                 for r in batch:
                     if not r.future.done():
+                        r.future.meta = reqtrace.finish(
+                            r.trace, "failed",
+                            reason=f"worker died: {type(e).__name__}: "
+                                   f"{e}")
                         r.future.set_error(e)
                 self._die(e)
                 return
@@ -340,8 +396,12 @@ class DynamicBatcher:
             self._cv.notify_all()
         for r in pending:
             if not r.future.done():
+                r.future.meta = reqtrace.finish(
+                    r.trace, "failed",
+                    reason=f"batcher worker died: {error}")
                 r.future.set_error(RejectedError(
-                    f"batcher worker died: {error}"))
+                    f"batcher worker died: {error}",
+                    request_id=r.request_id))
         print(f"serving batcher worker died: {type(error).__name__}: "
               f"{error}")
 
@@ -359,7 +419,11 @@ class DynamicBatcher:
             if not drain:
                 pending, self._queue = self._queue, []
                 for r in pending:
-                    r.future.set_error(RejectedError("batcher closed"))
+                    r.future.meta = reqtrace.finish(
+                        r.trace, "rejected_closed",
+                        reason="batcher closed")
+                    r.future.set_error(RejectedError(
+                        "batcher closed", request_id=r.request_id))
                 with self.stats.lock:
                     self.stats.queue_depth = 0
             self._cv.notify_all()

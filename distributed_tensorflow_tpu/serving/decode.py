@@ -40,6 +40,8 @@ computes the dense triangle the blockwise form equals.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from distributed_tensorflow_tpu.models.transformer import (
@@ -50,6 +52,7 @@ from distributed_tensorflow_tpu.models.transformer import (
 )
 from distributed_tensorflow_tpu.ops import nn
 from distributed_tensorflow_tpu.ops.attention import multi_head_attention
+from distributed_tensorflow_tpu.serving import reqtrace
 
 
 def check_decodable(model) -> None:
@@ -223,9 +226,15 @@ def generate(model, params, prompts, max_new_tokens: int, *,
 
     padded = np.zeros((b, capacity), dtype=np.int32)
     padded[:, :p] = prompts
+    # request plane: the prompt pass (prefill_fn dispatch + the first
+    # logits readback) is the "prefill" phase; the autoregressive loop
+    # below is "decode" with one tick per generated token
+    t0 = time.perf_counter()
     logits_all, cache = prefill_fn(params, jnp.asarray(padded))
     step_logits = np.asarray(logits_all[:, p - 1])
+    reqtrace.note_phase("prefill", time.perf_counter() - t0)
 
+    t0 = time.perf_counter()
     out_tokens = [prompts.astype(np.int32)]
     out_logits = []
     for i in range(n):
@@ -243,5 +252,6 @@ def generate(model, params, prompts, max_new_tokens: int, *,
                                          jnp.asarray(tok),
                                          jnp.int32(p + i))
             step_logits = np.asarray(step_logits)
+    reqtrace.note_phase("decode", time.perf_counter() - t0, ticks=n)
     return {"tokens": np.concatenate(out_tokens, axis=1)[:b_real],
             "logits": np.stack(out_logits, axis=1)[:b_real]}

@@ -38,6 +38,7 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     latest_checkpoint,
     restore_params_with_fallback,
 )
+from distributed_tensorflow_tpu.serving import reqtrace
 from distributed_tensorflow_tpu.utils import resources
 from distributed_tensorflow_tpu.utils.faults import fault_point
 from distributed_tensorflow_tpu.utils.telemetry import trace_span
@@ -199,11 +200,17 @@ class InferenceEngine:
             xb = x
         params, _ = self.current()
         fn = self._apply_fn()
+        # request plane: the forward (staging + dispatch + the
+        # device->host readback) is the predict route's "prefill"
+        # phase, attributed to every request in the current microbatch
+        t0 = time.perf_counter()
         if self.jit:
             out = fn(params, self._stage(xb))
         else:
             out = fn(params, xb)
-        return np.asarray(out)[:b]
+        out = np.asarray(out)[:b]
+        reqtrace.note_phase("prefill", time.perf_counter() - t0)
+        return out
 
     def generate(self, prompts, max_new_tokens: int, *,
                  temperature: float = 0.0, seed: int | None = None) -> dict:

@@ -36,10 +36,36 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from distributed_tensorflow_tpu.serving import reqtrace
 from distributed_tensorflow_tpu.serving.batcher import (
     DynamicBatcher,
     RejectedError,
 )
+
+
+def _result_with_id(fut, wait_s: float):
+    """``fut.result`` that stamps the request_id onto a TimeoutError:
+    a timed-out request is still running server-side and WILL land in
+    the audit ring/span sink — the 504 must carry the id that joins
+    the client's log line to that record."""
+    try:
+        return fut.result(wait_s)
+    except TimeoutError as e:
+        e.request_id = fut.request_id
+        raise
+
+
+def _future_meta(fut) -> dict:
+    """The wire-facing request metadata from a completed Future: the
+    echoed request_id always; the phase breakdown and disposition when
+    the request plane is configured (serving/reqtrace.py)."""
+    meta = {"request_id": fut.request_id}
+    if fut.meta is not None:
+        meta["disposition"] = fut.meta["disposition"]
+        meta["phases_ms"] = fut.meta["phases_ms"]
+        meta["total_ms"] = fut.meta["total_ms"]
+        meta["bucket"] = fut.meta["bucket"]
+    return meta
 
 
 class InProcessClient:
@@ -65,17 +91,40 @@ class InProcessClient:
 
     def predict(self, x, timeout_ms: float | None = None,
                 wait_s: float = 30.0):
+        return self.predict_ex(x, timeout_ms=timeout_ms,
+                               wait_s=wait_s)[0]
+
+    def predict_ex(self, x, timeout_ms: float | None = None,
+                   wait_s: float = 30.0,
+                   request_id: str | None = None):
+        """``(outputs, meta)`` — meta carries the echoed request_id and,
+        with the request plane configured, the phase breakdown +
+        disposition (what the HTTP routes put on the wire)."""
         if self.predict_batcher is None:
             raise ValueError(
                 "this server is not configured for predict")
         fut = self.predict_batcher.submit(np.asarray(x),
-                                          timeout_ms=timeout_ms)
-        return fut.result(wait_s)
+                                          timeout_ms=timeout_ms,
+                                          request_id=request_id)
+        out = _result_with_id(fut, wait_s)
+        return out, _future_meta(fut)
 
     def generate(self, prompt, max_new_tokens: int | None = None,
                  temperature: float | None = None,
                  seed: int | None = None,
                  timeout_ms: float | None = None, wait_s: float = 60.0):
+        return self.generate_ex(prompt, max_new_tokens=max_new_tokens,
+                                temperature=temperature, seed=seed,
+                                timeout_ms=timeout_ms,
+                                wait_s=wait_s)[0]
+
+    def generate_ex(self, prompt, max_new_tokens: int | None = None,
+                    temperature: float | None = None,
+                    seed: int | None = None,
+                    timeout_ms: float | None = None,
+                    wait_s: float = 60.0,
+                    request_id: str | None = None):
+        """``(tokens, meta)`` — the generate twin of ``predict_ex``."""
         if self.generate_batcher is None:
             raise ValueError(
                 "this server's model does not support generate "
@@ -91,9 +140,11 @@ class InProcessClient:
              else float(temperature))
         fut = self.generate_batcher.submit(
             np.asarray(prompt, dtype=np.int32), timeout_ms=timeout_ms,
+            request_id=request_id,
             max_new_tokens=n, temperature=t,
             seed=None if seed is None else int(seed))
-        return fut.result(wait_s)
+        out = _result_with_id(fut, wait_s)
+        return out, _future_meta(fut)
 
 
 def make_predict_runner(engine):
@@ -219,6 +270,16 @@ class ServingMetrics:
         rm = getattr(self.engine, "resources", None)
         if rm is not None:
             scalars.update({f"{p}{k}": v for k, v in rm.scalars().items()})
+        # request plane (r19): the SLO story rides the scalar cadence
+        # too, so compliance/burn trend lines land in serve_metrics
+        # .jsonl + TB next to the latency quantiles
+        plane = reqtrace.get_plane()
+        if plane is not None and plane.slo is not None:
+            slo = plane.slo.report()
+            scalars[f"{p}slo_compliant_pct"] = slo["compliant_pct"]
+            scalars[f"{p}slo_budget_remaining_pct"] = \
+                slo["budget_remaining_pct"]
+            scalars[f"{p}slo_burn_rate_fast"] = slo["burn_rate_fast"]
         if self.logger is not None:
             self.logger.scalars(n, scalars)
             # the serving cadence is this logger's display step: push
@@ -260,30 +321,47 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError) as e:
             self._send(400, {"error": f"bad JSON: {e}"})
             return
+        # client-suppliable request id, echoed on EVERY response shape
+        # (success, backpressure, error) so the client's log line and
+        # the replica's audit ring/span sink name the same request
+        rid = req.get("request_id") if isinstance(req, dict) else None
         try:
             if self.path == "/v1/predict":
-                out = srv.client.predict(
+                out, meta = srv.client.predict_ex(
                     np.asarray(req["inputs"]),
-                    timeout_ms=req.get("timeout_ms"))
-                self._send(200, {"outputs": np.asarray(out).tolist()})
+                    timeout_ms=req.get("timeout_ms"),
+                    request_id=rid)
+                self._send(200, {"outputs": np.asarray(out).tolist(),
+                                 **meta})
             elif self.path == "/v1/generate":
-                toks = srv.client.generate(
+                toks, meta = srv.client.generate_ex(
                     req["prompt"],
                     max_new_tokens=req.get("max_new_tokens"),
                     temperature=req.get("temperature"),
                     seed=req.get("seed"),
-                    timeout_ms=req.get("timeout_ms"))
-                self._send(200, {"tokens": np.asarray(toks).tolist()})
+                    timeout_ms=req.get("timeout_ms"),
+                    request_id=rid)
+                self._send(200, {"tokens": np.asarray(toks).tolist(),
+                                 **meta})
             else:
                 self._send(404, {"error": f"no route {self.path}"})
         except RejectedError as e:
-            self._send(429, {"error": e.reason, "rejected": True})
+            self._send(429, {"error": e.reason, "rejected": True,
+                             "request_id": getattr(e, "request_id",
+                                                   None) or rid})
         except (KeyError, ValueError) as e:
-            self._send(400, {"error": f"{type(e).__name__}: {e}"})
-        except TimeoutError:
-            self._send(504, {"error": "request timed out in flight"})
+            self._send(400, {"error": f"{type(e).__name__}: {e}",
+                             "request_id": rid})
+        except TimeoutError as e:
+            # the id matters MOST here: the request is still running
+            # server-side and will land in the audit ring/span sink —
+            # the client's log line must be joinable to it
+            self._send(504, {"error": "request timed out in flight",
+                             "request_id": getattr(e, "request_id",
+                                                   None) or rid})
         except Exception as e:  # noqa: BLE001 — the wire must answer
-            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            self._send(500, {"error": f"{type(e).__name__}: {e}",
+                             "request_id": rid})
 
 
 class InferenceServer:
@@ -387,13 +465,21 @@ class InferenceServer:
         low = bool(hbm is not None and self.hbm_headroom_floor_pct > 0
                    and 0 <= hbm["min_device_headroom_pct"]
                    < self.hbm_headroom_floor_pct)
-        return {"ok": not closed and not low, "step": self.engine.step,
+        # SLO layer (r19): a fast-burn breach of the error budget flips
+        # the replica unhealthy — the router drains it like the HBM
+        # floor. Unarmed (no --slo_p99_ms, or telemetry off) never
+        # trips.
+        plane = reqtrace.get_plane()
+        slo_burn = bool(plane is not None and plane.fast_burn_breach())
+        return {"ok": not closed and not low and not slo_burn,
+                "step": self.engine.step,
                 "params_step": self.engine.step,
                 "closed_batchers": closed,
                 "queue_depth": depth,
                 "hbm_headroom_pct": (hbm["headroom_pct"]
                                      if hbm is not None else None),
                 "hbm_low_headroom": low,
+                "slo_fast_burn": slo_burn,
                 "uptime_s": round(time.monotonic() - self._t0, 3)}
 
     def _goodput_uptime_pct(self) -> float:
@@ -477,6 +563,16 @@ class InferenceServer:
                                  if snt is not None else None)
         out["recompiles_total"] = (float(snt.recompiles_total)
                                    if snt is not None else None)
+        # request plane (r19): the tail block — p50-vs-p99 decomposed
+        # by phase per route and shape-bucket, with the worst live
+        # exemplars NAMED (request_id + phase breakdown) — and the SLO
+        # ledger (compliant_pct, budget remaining, burn rates). None
+        # when the plane is unconfigured (--telemetry=false).
+        plane = reqtrace.get_plane()
+        out["tail"] = (plane.tail_report() if plane is not None
+                       else None)
+        out["slo"] = (plane.slo_report() if plane is not None
+                      else None)
         for name, b in self._batchers():
             stats = b.stats.as_dict()
             entry = dict(stats)

@@ -160,6 +160,21 @@ class Tracer:
                    depth=len(self._stack()), instant=True)
         self._finish(rec)
 
+    def record_complete(self, name: str, ts: float, dur_s: float,
+                        attrs=None) -> None:
+        """A retroactively-timed COMPLETED span: the caller measured
+        ``(ts, dur_s)`` itself and emits after the fact (the request
+        plane's phase segments are measured as a request moves through
+        the batcher and emitted together at request finish)."""
+        if not self.enabled:
+            return
+        rec = {k: _json_safe(v) for k, v in (attrs or {}).items()}
+        rec.update(name=name, ts=float(ts), dur_s=float(dur_s),
+                   tid=threading.get_ident(),
+                   thread=threading.current_thread().name,
+                   depth=len(self._stack()))
+        self._finish(rec)
+
     def configure_sink(self, path: str | None) -> None:
         """Set (or clear) the spans JSONL file; flushes are batched —
         the loops call ``flush()`` at the display cadence and every
@@ -215,6 +230,14 @@ def trace_span(name: str, **attrs):
 
 def get_tracer() -> Tracer:
     return _TRACER
+
+
+def record_span(name: str, *, ts: float, dur_s: float, **attrs) -> None:
+    """Emit a retroactively-timed completed span to the global tracer
+    (see ``Tracer.record_complete``) — the serving request plane's
+    emission entry point. Subject to the span taxonomy like
+    ``trace_span``/``record_instant`` (dttlint DTT005)."""
+    _TRACER.record_complete(name, ts, dur_s, attrs or None)
 
 
 def last_spans(k: int = WATCHDOG_LAST_SPANS) -> list:

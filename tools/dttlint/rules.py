@@ -352,17 +352,24 @@ def _value_constants(expr) -> list:
     return []
 
 
+# the three span-emitting entry points DTT005 audits: the live context
+# manager, the instant marker, and the request plane's retroactively-
+# timed completed span (utils/telemetry.record_span)
+_SPAN_CALLEES = ("trace_span", "record_instant", "record_span")
+
+
 def _has_span_sites(index) -> bool:
     return any(
         isinstance(n, ast.Call) and
-        _callee(n) in ("trace_span", "record_instant") and n.args
+        _callee(n) in _SPAN_CALLEES and n.args
         for tree in index.trees.values() for n, _ in _walk_scoped(tree))
 
 
 def rule_span_taxonomy(index) -> list:
-    """DTT005: every ``trace_span``/``record_instant`` name literal
-    appears in the ARCHITECTURE span-taxonomy table, and every table
-    row has a live call site — docs drift flags in BOTH directions.
+    """DTT005: every ``trace_span``/``record_instant``/``record_span``
+    name literal appears in the ARCHITECTURE span-taxonomy table, and
+    every table row has a live call site — docs drift flags in BOTH
+    directions.
     A walk set WITH span sites but WITHOUT a parseable taxonomy table
     is itself a finding: the rule must never self-disable silently
     (a reworded table header would otherwise green every invariant
@@ -390,7 +397,7 @@ def rule_span_taxonomy(index) -> list:
                         enclosing.setdefault(id(sub), node)
         for node, qual in _walk_scoped(tree):
             if not (isinstance(node, ast.Call) and
-                    _callee(node) in ("trace_span", "record_instant")
+                    _callee(node) in _SPAN_CALLEES
                     and node.args):
                 continue
             names, prefixes = _resolve_span_name(

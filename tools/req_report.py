@@ -1,0 +1,401 @@
+#!/usr/bin/env python
+"""Merge serving span files into per-request waterfalls, tail-latency
+exemplar tables, and SLO compliance over time — the request plane's
+offline report (tools/fleet_report.py's serving sibling).
+
+Every serving replica writes ``spans-serve-N.jsonl`` (PR-6 spine); the
+request plane (serving/reqtrace.py) emits each finished request into it
+as backdated ``req:<phase>`` spans plus one ``req:done`` instant
+carrying the summary (disposition, reason, total, phase breakdown).
+This tool reconstructs the whole per-request story from the files alone
+— including rejections and deadline expiries, which never produced a
+response body anyone kept:
+
+- **Waterfalls.** Requests grouped by ``request_id``; each renders as
+  its ordered phase segments (admit / queue_wait / batch_assembly /
+  prefill / decode / respond) with offsets — ``--request ID`` shows one
+  in detail.
+- **Completeness.** A finished request must have a ``req:done`` record
+  and the phase spans its disposition implies (an "ok" without a
+  ``respond`` span is a hole in the plane). Incomplete timelines are
+  listed and set the exit code.
+- **Tail attribution.** Per (route, shape-bucket): p50-vs-p99 by phase
+  recomputed offline — the same decomposition the live ``/metrics``
+  tail block serves — plus the worst-N exemplar table (request_id,
+  disposition, dominant phase, per-phase ms).
+- **SLO over time.** With ``--slo_p99_ms``: per-window compliance
+  (``--window_s`` buckets on the req:done wall clock), overall
+  compliant_pct, and the budget spent against ``--slo_target_pct``.
+- **Chrome export.** ``--chrome out.json`` gives every request its own
+  named track (one tid per request) — load in chrome://tracing /
+  ui.perfetto.dev and read the fleet of waterfalls on one clock.
+
+Exit codes: 0 = every request timeline complete; 1 = incomplete
+timelines found; 2 = no request-plane records in the input.
+
+Usage:
+    python tools/req_report.py LOGDIR                # all spans-*.jsonl
+    python tools/req_report.py spans-serve-0.jsonl [more.jsonl ...]
+    python tools/req_report.py LOGDIR --slo_p99_ms 50 --window_s 10
+    python tools/req_report.py LOGDIR --json
+    python tools/req_report.py LOGDIR --chrome requests.json
+    python tools/req_report.py LOGDIR --request req-ab12cd-000007
+
+stdlib-only beyond utils/telemetry (via tools/trace_view's loaders) —
+run it anywhere the JSONL files land, no jax, no chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tools.trace_view import load_records  # noqa: E402
+
+PHASE_ORDER = ("admit", "queue_wait", "batch_assembly", "prefill",
+               "decode", "respond")
+
+# the phases a disposition's timeline must include to count complete
+# (beyond them, what a request has depends on where it died)
+REQUIRED_PHASES = {
+    "ok": ("admit", "queue_wait", "batch_assembly", "respond"),
+    "expired": ("admit", "queue_wait"),
+    "failed": ("admit",),
+    "rejected_full": ("admit",),
+    "rejected_closed": ("admit",),
+    "rejected_fault": ("admit",),
+}
+
+
+def discover_span_files(target: str) -> list[str]:
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target, "spans-*.jsonl")))
+    return [target] if os.path.exists(target) else []
+
+
+def collect_requests(records: list[dict]) -> dict[str, dict]:
+    """Group the req:* records by request_id ->
+    {id, route, bucket, disposition, reason, total_ms, decode_ticks,
+    t_wall, phases: {name: {dur_ms, ts}}, done: bool}."""
+    out: dict[str, dict] = {}
+    for r in records:
+        name = r.get("name", "")
+        if not name.startswith("req:"):
+            continue
+        rid = r.get("request_id")
+        if not rid:
+            continue
+        req = out.setdefault(rid, {
+            "request_id": rid, "route": r.get("route"),
+            "bucket": r.get("bucket"), "disposition": None,
+            "reason": None, "total_ms": None, "decode_ticks": 0,
+            "t_wall": None, "phases": {}, "done": False})
+        if name == "req:done":
+            req["done"] = True
+            req["disposition"] = r.get("disposition")
+            req["reason"] = r.get("reason")
+            req["total_ms"] = r.get("total_ms")
+            req["decode_ticks"] = r.get("decode_ticks", 0)
+            req["t_wall"] = float(r.get("ts", 0.0))
+        else:
+            phase = name[len("req:"):]
+            req["phases"][phase] = {
+                "dur_ms": float(r.get("dur_s", 0.0)) * 1e3,
+                "ts": float(r.get("ts", 0.0))}
+            if req["t_wall"] is None or float(r.get("ts", 0.0)) \
+                    < req["t_wall"]:
+                req["t_wall"] = float(r.get("ts", 0.0))
+    return out
+
+
+def incomplete_requests(requests: dict[str, dict]) -> list[dict]:
+    """Requests whose timeline cannot be reconstructed: no req:done
+    summary, or missing the phase spans their disposition implies."""
+    bad = []
+    for rid, req in sorted(requests.items()):
+        if not req["done"]:
+            bad.append({"request_id": rid, "missing": ["req:done"]})
+            continue
+        need = REQUIRED_PHASES.get(req["disposition"], ("admit",))
+        missing = [p for p in need if p not in req["phases"]]
+        if missing:
+            bad.append({"request_id": rid,
+                        "disposition": req["disposition"],
+                        "missing": missing})
+    return bad
+
+
+def _quantile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def tail_attribution(requests: dict[str, dict]) -> dict:
+    """Offline recomputation of the /metrics tail block: per (route,
+    bucket), p50/p99 total and by phase, with the dominant phase at
+    the tail named."""
+    groups: dict = {}
+    for req in requests.values():
+        if req["total_ms"] is None:
+            continue
+        key = (str(req["route"]), str(req["bucket"]))
+        g = groups.setdefault(key, {"total": [], "phases": {}})
+        g["total"].append(float(req["total_ms"]))
+        for phase, seg in req["phases"].items():
+            g["phases"].setdefault(phase, []).append(seg["dur_ms"])
+    out: dict = {}
+    for (route, bucket), g in sorted(groups.items()):
+        totals = sorted(g["total"])
+        entry = {"count": len(totals),
+                 "total": {"p50_ms": round(_quantile(totals, 0.5), 3),
+                           "p99_ms": round(_quantile(totals, 0.99), 3)},
+                 "phases": {}}
+        p99s = {}
+        for phase, vals in g["phases"].items():
+            vals = sorted(vals)
+            s = {"p50_ms": round(_quantile(vals, 0.5), 3),
+                 "p99_ms": round(_quantile(vals, 0.99), 3)}
+            entry["phases"][phase] = s
+            p99s[phase] = s["p99_ms"]
+        entry["p99_dominant_phase"] = (max(p99s, key=p99s.get)
+                                       if p99s else None)
+        out.setdefault(route, {})[bucket] = entry
+    return out
+
+
+def exemplar_table(requests: dict[str, dict], top: int) -> list[dict]:
+    done = [r for r in requests.values() if r["total_ms"] is not None]
+    worst = sorted(done, key=lambda r: r["total_ms"], reverse=True)[:top]
+    out = []
+    for r in worst:
+        durs = {p: seg["dur_ms"] for p, seg in r["phases"].items()}
+        out.append({
+            "request_id": r["request_id"], "route": r["route"],
+            "bucket": r["bucket"], "disposition": r["disposition"],
+            "reason": r["reason"],
+            "total_ms": round(float(r["total_ms"]), 3),
+            "dominant_phase": (max(durs, key=durs.get) if durs else None),
+            "phases_ms": {p: round(v, 3) for p, v in durs.items()},
+        })
+    return out
+
+
+def slo_over_time(requests: dict[str, dict], slo_p99_ms: float,
+                  target_pct: float, window_s: float) -> dict | None:
+    """Compliance bucketed on the req:done wall clock: per-window
+    compliant percentage plus the overall budget story — the offline
+    twin of the live ledger (windowed on wall time here; the live
+    ledger windows on arrival)."""
+    if not slo_p99_ms or slo_p99_ms <= 0:
+        return None
+    done = [r for r in requests.values()
+            if r["done"] and r["t_wall"] is not None]
+    if not done:
+        return None
+    t0 = min(r["t_wall"] for r in done)
+    windows: dict[int, list] = {}
+    total = bad = 0
+    for r in done:
+        ok = (r["disposition"] == "ok" and r["total_ms"] is not None
+              and float(r["total_ms"]) <= slo_p99_ms)
+        total += 1
+        bad += not ok
+        windows.setdefault(int((r["t_wall"] - t0) / window_s),
+                           []).append(ok)
+    allowed = max(1.0 - target_pct / 100.0, 1e-9)
+    series = [{"window": w, "t_offset_s": round(w * window_s, 3),
+               "requests": len(oks),
+               "compliant_pct": round(100.0 * sum(oks) / len(oks), 4)}
+              for w, oks in sorted(windows.items())]
+    return {
+        "slo_p99_ms": slo_p99_ms, "slo_target_pct": target_pct,
+        "requests": total,
+        "compliant_pct": round(100.0 * (1 - bad / total), 4),
+        "budget_spent": round((bad / total) / allowed, 4),
+        "window_s": window_s,
+        "windows": series,
+    }
+
+
+def chrome_trace_per_request(requests: dict[str, dict]) -> dict:
+    """Chrome-trace JSON with ONE TRACK PER REQUEST: every request gets
+    its own tid (thread_name = request_id), so the exemplars read as
+    parallel waterfalls on one clock."""
+    events = []
+    order = sorted(requests.values(),
+                   key=lambda r: r["t_wall"] if r["t_wall"] is not None
+                   else 0.0)
+    for i, req in enumerate(order):
+        events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                       "tid": i,
+                       "args": {"name": f"{req['request_id']} "
+                                        f"[{req['disposition']}]"}})
+        for phase, seg in sorted(req["phases"].items(),
+                                 key=lambda kv: kv[1]["ts"]):
+            events.append({
+                "name": f"req:{phase}", "ph": "X", "pid": 1, "tid": i,
+                "ts": seg["ts"] * 1e6, "dur": seg["dur_ms"] * 1e3,
+                "cat": "reqtrace",
+                "args": {"request_id": req["request_id"],
+                         "route": req["route"],
+                         "bucket": req["bucket"],
+                         "disposition": req["disposition"]}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def waterfall_lines(req: dict) -> list[str]:
+    t0 = min((seg["ts"] for seg in req["phases"].values()),
+             default=req["t_wall"] or 0.0)
+    lines = [f"request {req['request_id']}  route={req['route']} "
+             f"bucket={req['bucket']} disposition={req['disposition']}"
+             + (f" reason={req['reason']!r}" if req["reason"] else "")
+             + (f" total={req['total_ms']:.3f}ms"
+                if req["total_ms"] is not None else "")]
+    for phase in PHASE_ORDER:
+        seg = req["phases"].get(phase)
+        if seg is None:
+            continue
+        off = (seg["ts"] - t0) * 1e3
+        extra = (f"  ticks={req['decode_ticks']}"
+                 if phase == "decode" and req["decode_ticks"] else "")
+        lines.append(f"  +{off:9.3f}ms  {phase:<15} "
+                     f"{seg['dur_ms']:9.3f}ms{extra}")
+    return lines
+
+
+def build_report(requests: dict[str, dict], *, top: int,
+                 slo_p99_ms: float, slo_target_pct: float,
+                 window_s: float) -> dict:
+    by_disp: dict[str, int] = {}
+    for r in requests.values():
+        d = r["disposition"] or "(no req:done)"
+        by_disp[d] = by_disp.get(d, 0) + 1
+    incomplete = incomplete_requests(requests)
+    return {
+        "requests_total": len(requests),
+        "by_disposition": by_disp,
+        "incomplete": incomplete,
+        "complete_pct": round(
+            100.0 * (1 - len(incomplete) / len(requests)), 4)
+        if requests else None,
+        "tail": tail_attribution(requests),
+        "exemplars": exemplar_table(requests, top),
+        "slo": slo_over_time(requests, slo_p99_ms, slo_target_pct,
+                             window_s),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("targets", nargs="+",
+                    help="a logdir (all spans-*.jsonl) or span files")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="write Chrome-trace JSON, one track per request")
+    ap.add_argument("--request", metavar="ID",
+                    help="show one request's waterfall in detail")
+    ap.add_argument("--top", type=int, default=10,
+                    help="exemplar-table size (worst by total latency)")
+    ap.add_argument("--slo_p99_ms", type=float, default=0.0,
+                    help="latency SLO for offline compliance (0 = skip)")
+    ap.add_argument("--slo_target_pct", type=float, default=99.0)
+    ap.add_argument("--window_s", type=float, default=10.0,
+                    help="SLO-over-time window width (seconds)")
+    args = ap.parse_args(argv)
+
+    files: list[str] = []
+    for t in args.targets:
+        files += discover_span_files(t)
+    if not files:
+        print(f"no span files found under {args.targets}",
+              file=sys.stderr)
+        return 2
+    records: list[dict] = []
+    for path in files:
+        records += load_records(path)
+    requests = collect_requests(records)
+    if not requests:
+        print(f"no request-plane (req:*) records in {len(files)} span "
+              f"file(s) — is the plane configured (--telemetry and "
+              f"serving/reqtrace)?", file=sys.stderr)
+        return 2
+
+    if args.request:
+        req = requests.get(args.request)
+        if req is None:
+            print(f"request {args.request!r} not found "
+                  f"({len(requests)} requests in input)",
+                  file=sys.stderr)
+            return 2
+        print("\n".join(waterfall_lines(req)))
+        return 0
+
+    report = build_report(requests, top=args.top,
+                          slo_p99_ms=args.slo_p99_ms,
+                          slo_target_pct=args.slo_target_pct,
+                          window_s=args.window_s)
+
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_trace_per_request(requests), f)
+        print(f"wrote {args.chrome} ({len(requests)} request tracks)",
+              file=sys.stderr)
+
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"{report['requests_total']} requests from {len(files)} "
+              f"span file(s); by disposition: "
+              f"{json.dumps(report['by_disposition'])}")
+        if report["incomplete"]:
+            print(f"INCOMPLETE timelines: {len(report['incomplete'])}")
+            for bad in report["incomplete"][:10]:
+                print(f"  {bad['request_id']}: missing "
+                      f"{','.join(bad['missing'])}")
+        print("\ntail attribution (p50 / p99 ms by phase):")
+        for route, buckets in report["tail"].items():
+            for bucket, entry in buckets.items():
+                dom = entry["p99_dominant_phase"]
+                print(f"  {route} @ bucket {bucket}  "
+                      f"n={entry['count']}  total "
+                      f"{entry['total']['p50_ms']}/"
+                      f"{entry['total']['p99_ms']}  "
+                      f"p99-dominant: {dom}")
+                for phase in PHASE_ORDER:
+                    s = entry["phases"].get(phase)
+                    if s:
+                        print(f"      {phase:<15} {s['p50_ms']:9.3f} / "
+                              f"{s['p99_ms']:9.3f}")
+        print("\nworst exemplars:")
+        for ex in report["exemplars"]:
+            print(f"  {ex['request_id']}  {ex['route']}@"
+                  f"{ex['bucket']}  {ex['total_ms']:9.3f}ms  "
+                  f"[{ex['disposition']}] dominant: "
+                  f"{ex['dominant_phase']}")
+        if report["slo"]:
+            s = report["slo"]
+            print(f"\nSLO {s['slo_p99_ms']}ms @ {s['slo_target_pct']}%:"
+                  f" compliant {s['compliant_pct']}% over "
+                  f"{s['requests']} requests (budget spent "
+                  f"{s['budget_spent']}x)")
+            for w in s["windows"]:
+                print(f"  t+{w['t_offset_s']:8.1f}s  "
+                      f"n={w['requests']:<6} "
+                      f"compliant {w['compliant_pct']}%")
+    return 1 if report["incomplete"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,14 @@ and hides queueing collapse. Both return the same report dict
 (p50/p90/p99 latency ms, achieved rps, ok/rejected/error counts), both
 drive either the in-process client or a JSON-over-HTTP endpoint.
 
+Request plane (r19): every offered request carries a CLIENT-side
+``request_id``; the response must echo it (a mismatch counts as an
+error — ``id_echo_failures`` in the report, never silent). When the
+server's request plane is armed, responses carry the per-request phase
+breakdown, and the summary grows phase-attributed latency columns
+(``phase_ms``: client-observed p50/p99 per server phase) plus SLO
+compliance (``slo_compliant_pct`` against ``--slo_p99_ms``).
+
 CLI (HTTP mode):
 
     python tools/serve_loadgen.py --url http://127.0.0.1:8000 \
@@ -40,17 +48,10 @@ from distributed_tensorflow_tpu.serving.batcher import RejectedError
 from distributed_tensorflow_tpu.utils.metrics import StreamingHistogram
 
 
-def _report(hist: StreamingHistogram, ok: int, rejected: int,
-            errors: int, elapsed_s: float) -> dict:
-    out = dict(hist.summary("latency_ms_"))
-    out.update({
-        "ok": ok,
-        "rejected": rejected,
-        "errors": errors,
-        "elapsed_s": round(elapsed_s, 3),
-        "achieved_rps": round(ok / elapsed_s, 2) if elapsed_s > 0 else 0.0,
-    })
-    return out
+class EchoMismatchError(RuntimeError):
+    """The response's request_id is not the one this client sent — the
+    id round-trip contract is broken (counted separately: a miswired
+    plane must not hide inside the generic error count)."""
 
 
 class _Counters:
@@ -59,19 +60,67 @@ class _Counters:
         self.ok = 0
         self.rejected = 0
         self.errors = 0
+        self.id_echo_failures = 0
+        self.slo_compliant = 0
+        self.phase_hists: dict[str, StreamingHistogram] = {}
 
     def add(self, kind: str):
         with self.lock:
             setattr(self, kind, getattr(self, kind) + 1)
 
+    def phases(self, phases_ms: dict):
+        with self.lock:
+            for phase, ms in phases_ms.items():
+                h = self.phase_hists.get(phase)
+                if h is None:
+                    h = self.phase_hists[phase] = StreamingHistogram()
+                h.record(float(ms))
 
-def _call_and_record(request_fn, hist: StreamingHistogram,
-                     c: _Counters) -> None:
+
+def _report(hist: StreamingHistogram, c: _Counters, elapsed_s: float,
+            slo_p99_ms: float | None = None) -> dict:
+    out = dict(hist.summary("latency_ms_"))
+    out.update({
+        "ok": c.ok,
+        "rejected": c.rejected,
+        "errors": c.errors,
+        "id_echo_failures": c.id_echo_failures,
+        "elapsed_s": round(elapsed_s, 3),
+        "achieved_rps": round(c.ok / elapsed_s, 2)
+        if elapsed_s > 0 else 0.0,
+    })
+    # phase-attributed latency: the server's per-request breakdown
+    # aggregated client-side (only present when the replica's request
+    # plane is armed and echoing phases)
+    with c.lock:
+        out["phase_ms"] = {
+            phase: {"p50": round(h.quantile(0.5), 3),
+                    "p99": round(h.quantile(0.99), 3),
+                    "mean": round(h.mean, 3)}
+            for phase, h in sorted(c.phase_hists.items())} or None
+    if slo_p99_ms and slo_p99_ms > 0:
+        out["slo_p99_ms"] = slo_p99_ms
+        total = c.ok + c.rejected + c.errors
+        out["slo_compliant_pct"] = (
+            round(100.0 * c.slo_compliant / total, 4) if total else None)
+    return out
+
+
+def _call_and_record(request_fn, hist: StreamingHistogram, c: _Counters,
+                     slo_p99_ms: float | None = None) -> None:
     t0 = time.monotonic()
     try:
-        request_fn()
-        hist.record((time.monotonic() - t0) * 1e3)
+        meta = request_fn()
+        latency_ms = (time.monotonic() - t0) * 1e3
+        hist.record(latency_ms)
         c.add("ok")
+        if slo_p99_ms and latency_ms <= slo_p99_ms:
+            c.add("slo_compliant")
+        if isinstance(meta, dict) and meta.get("phases_ms"):
+            c.phases(meta["phases_ms"])
+    except EchoMismatchError:
+        c.add("id_echo_failures")
+        c.add("errors")
     except RejectedError:
         c.add("rejected")
     except Exception:  # noqa: BLE001 — the loadgen reports, not raises
@@ -79,9 +128,13 @@ def _call_and_record(request_fn, hist: StreamingHistogram,
 
 
 def run_closed_loop(request_fn, *, n_requests: int = 200,
-                    concurrency: int = 4) -> dict:
+                    concurrency: int = 4,
+                    slo_p99_ms: float | None = None) -> dict:
     """``concurrency`` workers, one request in flight each, until
-    ``n_requests`` total have been attempted."""
+    ``n_requests`` total have been attempted. ``request_fn`` may return
+    a meta dict (``request_id``/``phases_ms``) to feed the
+    phase-attributed columns; ``slo_p99_ms`` adds client-judged SLO
+    compliance."""
     hist = StreamingHistogram()
     c = _Counters()
     issued = [0]
@@ -93,7 +146,7 @@ def run_closed_loop(request_fn, *, n_requests: int = 200,
                 if issued[0] >= n_requests:
                     return
                 issued[0] += 1
-            _call_and_record(request_fn, hist, c)
+            _call_and_record(request_fn, hist, c, slo_p99_ms)
 
     t0 = time.monotonic()
     threads = [threading.Thread(target=worker, daemon=True)
@@ -102,12 +155,12 @@ def run_closed_loop(request_fn, *, n_requests: int = 200,
         t.start()
     for t in threads:
         t.join()
-    return _report(hist, c.ok, c.rejected, c.errors,
-                   time.monotonic() - t0)
+    return _report(hist, c, time.monotonic() - t0, slo_p99_ms)
 
 
 def run_open_loop(request_fn, *, rate_rps: float, duration_s: float,
-                  max_inflight: int = 256) -> dict:
+                  max_inflight: int = 256,
+                  slo_p99_ms: float | None = None) -> dict:
     """Fire at ``rate_rps`` (uniform arrivals) for ``duration_s``; each
     request runs on its own thread so a slow server cannot throttle the
     arrival process (that's the point of open loop). ``max_inflight``
@@ -123,7 +176,7 @@ def run_open_loop(request_fn, *, rate_rps: float, duration_s: float,
 
     def one():
         try:
-            _call_and_record(request_fn, hist, c)
+            _call_and_record(request_fn, hist, c, slo_p99_ms)
         finally:
             inflight.release()
 
@@ -145,7 +198,7 @@ def run_open_loop(request_fn, *, rate_rps: float, duration_s: float,
     t_offered = time.monotonic() - t0
     for th in threads:
         th.join(timeout=30)
-    out = _report(hist, c.ok, c.rejected, c.errors, t_offered)
+    out = _report(hist, c, t_offered, slo_p99_ms)
     out["drain_s"] = round(time.monotonic() - t0 - t_offered, 3)
     out["offered_rps"] = rate_rps
     return out
@@ -156,29 +209,43 @@ def http_request_fn(url: str, kind: str, *, prompt_len: int = 8,
                     max_new_tokens: int = 16):
     """A request closure against the HTTP front end. Raises
     ``RejectedError`` on 429 so backpressure is counted, not miscounted
-    as an error."""
+    as an error. Every call tags its payload with a fresh client-side
+    ``request_id`` and verifies the response echoes it
+    (``EchoMismatchError`` otherwise); returns the response's meta
+    (request_id + phases_ms when the server's request plane is armed)
+    for the phase-attributed summary columns."""
+    from distributed_tensorflow_tpu.serving.reqtrace import (
+        new_request_id,
+    )
 
     if kind == "generate":
-        body = json.dumps({
-            "prompt": [i % vocab_size for i in range(prompt_len)],
-            "max_new_tokens": max_new_tokens}).encode()
+        payload = {"prompt": [i % vocab_size for i in range(prompt_len)],
+                   "max_new_tokens": max_new_tokens}
         path = "/v1/generate"
     else:
-        body = json.dumps(
-            {"inputs": [0.5] * input_dim}).encode()
+        payload = {"inputs": [0.5] * input_dim}
         path = "/v1/predict"
 
     def call():
+        rid = new_request_id()
+        body = json.dumps({**payload, "request_id": rid}).encode()
         req = urllib.request.Request(
             url.rstrip("/") + path, data=body,
             headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
-                resp.read()
+                out = json.loads(resp.read())
         except urllib.error.HTTPError as e:
             if e.code == 429:
-                raise RejectedError(f"HTTP 429: {e.read()[:200]}") from e
+                raise RejectedError(f"HTTP 429: {e.read()[:200]}",
+                                    request_id=rid) from e
             raise
+        echoed = out.get("request_id")
+        if echoed != rid:
+            raise EchoMismatchError(
+                f"sent request_id {rid!r}, response echoed {echoed!r}")
+        return {"request_id": echoed,
+                "phases_ms": out.get("phases_ms")}
 
     return call
 
@@ -202,18 +269,23 @@ def main():
     ap.add_argument("--vocab_size", type=int, default=64)
     ap.add_argument("--input_dim", type=int, default=784)
     ap.add_argument("--max_new_tokens", type=int, default=16)
+    ap.add_argument("--slo_p99_ms", type=float, default=0.0,
+                    help="if > 0, add client-judged SLO compliance "
+                         "(slo_compliant_pct) to the summary")
     args = ap.parse_args()
 
     fn = http_request_fn(args.url, args.kind, prompt_len=args.prompt_len,
                          vocab_size=args.vocab_size,
                          input_dim=args.input_dim,
                          max_new_tokens=args.max_new_tokens)
+    slo = args.slo_p99_ms if args.slo_p99_ms > 0 else None
     if args.mode == "closed":
         rep = run_closed_loop(fn, n_requests=args.requests,
-                              concurrency=args.concurrency)
+                              concurrency=args.concurrency,
+                              slo_p99_ms=slo)
     else:
         rep = run_open_loop(fn, rate_rps=args.rate,
-                            duration_s=args.duration)
+                            duration_s=args.duration, slo_p99_ms=slo)
     print(json.dumps(rep))
 
 
