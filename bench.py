@@ -1204,7 +1204,7 @@ def reqtrace_phase() -> dict:
                               slo_p99_ms=REQTRACE_SLO_P99_MS)
         batcher.close(drain=False)
         assert rep["ok"] == REQTRACE_REQUESTS and rep["errors"] == 0, rep
-        audit = list(plane.audit)
+        audit = plane.audit_snapshot()
         need = {"admit", "queue_wait", "batch_assembly", "prefill",
                 "respond"}
         complete = [s for s in audit if s["disposition"] == "ok"
@@ -1895,6 +1895,43 @@ def lint_phase() -> dict:
                 "lint_error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def consan_phase() -> dict:
+    """dttsan drill (r20): run the static concurrency analyzer over the
+    whole walk set with the checked-in baseline + thread registry.
+    HOST-ONLY (pure ``ast``, no jax, no chip), so the ``consan_*``
+    facts stay NON-NULL in EVERY record including the degraded/outage
+    one, per the bench contract — PROGRESS tracks
+    ``consan_findings_total`` staying at zero (the host plane's
+    threads/locks/rings stay machine-proven race-free as the tree
+    grows) with ``consan_threads_total`` counting the live concurrent
+    roots the registry pins."""
+    try:
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools.dttsan import run_san
+
+        t0 = time.perf_counter()
+        res = run_san()
+        return {
+            "consan_findings_total": len(res.findings) + len(res.stale),
+            "consan_baselined_total": len(res.baselined),
+            "consan_threads_total": res.report["threads_total"],
+            "consan_locks_total": res.report["locks_total"],
+            "consan_shared_attrs": res.report["shared_attrs"],
+            "consan_time_s": round(time.perf_counter() - t0, 3),
+        }
+    except Exception as e:  # never kill the record over the drill
+        return {"consan_findings_total": None,
+                "consan_baselined_total": None,
+                "consan_threads_total": None,
+                "consan_locks_total": None,
+                "consan_shared_attrs": None,
+                "consan_time_s": None,
+                "consan_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 _JAXPRCHECK_CACHE: dict = {}
 
 
@@ -2210,6 +2247,9 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
     # r16: the dttlint drill is pure ast — the static-invariant facts
     # (findings/baseline trend) stay non-null through outages too
     out.update(lint_phase())
+    # r20: the dttsan drill is pure ast too — the concurrency-proof
+    # facts (thread/lock/ring census) stay non-null through outages
+    out.update(consan_phase())
     # r18: the dttcheck drill runs in its own CPU-mesh subprocess —
     # the jaxpr-proof facts stay non-null through outages too
     out.update(jaxprcheck_phase())
@@ -2341,6 +2381,10 @@ def _run_phases(out: dict):
     # tracked headline (trending to zero), and a nonzero finding count
     # in a bench record means the tree shipped a new invariant break
     out.update(lint_phase())
+    # r20: dttsan over the whole tree — the host plane's threads, locks
+    # and rings stay machine-proven race-free (a nonzero finding count
+    # means the tree shipped a new concurrency hazard)
+    out.update(consan_phase())
     # r18: dttcheck — the comm ledgers and SPMD safety machine-proven
     # against the lowered jaxpr for the full mode matrix (subprocess
     # with its own virtual CPU mesh; a nonzero finding count means an

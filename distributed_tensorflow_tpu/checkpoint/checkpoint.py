@@ -882,12 +882,13 @@ class Checkpointer:
         if not self.is_chief:
             return None
         self._drain()
-        if self._error is not None:
+        with self._cv:
+            prev_error, self._error = self._error, None
+        if prev_error is not None:
             # an older periodic write failed; this newer forced save
             # supersedes it — report, don't mask the final save with it
             print(f"note: a background checkpoint write had failed: "
-                  f"{self._error}")
-            self._error = None
+                  f"{prev_error}")
         path = _write_flat(self.directory, flat, step, self.max_to_keep)
         self._last_save = time.time()
         return path
@@ -1002,6 +1003,10 @@ class Checkpointer:
                 self._cv.wait()
 
     def _raise_pending_error(self):
-        if self._error is not None:
+        # read-and-clear under the cv: the writer thread SETS _error
+        # under it, and a lock-free test-then-clear here could drop an
+        # error landing between the two (dttsan SAN002)
+        with self._cv:
             e, self._error = self._error, None
+        if e is not None:
             raise RuntimeError(f"background checkpoint write failed: {e}") from e

@@ -585,8 +585,14 @@ class PSClient:
         if attempts < 1:
             raise ValueError(f"attempts must be >= 1, got {attempts}")
         key = (i, "push" if msg.get("op") == "push_grads" else "pull")
-        with self._chan_lock(key):
-            for attempt in range(attempts):
+        for attempt in range(attempts):
+            # the channel lock brackets ONE attempt, not the whole retry
+            # loop: the backoff sleep must not stall every other thread
+            # queued on this channel behind a dead connection (dttsan
+            # SAN003 blocking-under-lock). Request/response pairing is
+            # still atomic per attempt, which is all the serialization
+            # the framing needs.
+            with self._chan_lock(key):
                 # connection establishment is OUTSIDE the retry: _sock
                 # already spins its own reconnect deadline, and a connect
                 # failure means nothing was sent — resending adds no
@@ -601,7 +607,7 @@ class PSClient:
                     if (msg.get("op") not in self._RETRY_OPS
                             or attempt == attempts - 1):
                         raise
-                    time.sleep(0.2 * (attempt + 1))
+            time.sleep(0.2 * (attempt + 1))
 
     def _map_tasks(self, fn):
         """Run ``fn(i)`` for every ps task — concurrently when there is

@@ -123,20 +123,25 @@ def build_serving_stack(FLAGS):
                                latency=StreamingHistogram(),
                                on_batch=metrics.on_batch,
                                name="predict", **common)
-    client = InProcessClient(
-        predict_batcher=predict_b,
-        default_max_new_tokens=FLAGS.serve_max_new_tokens,
-        max_new_tokens_cap=FLAGS.serve_max_new_tokens,
-        default_temperature=FLAGS.serve_temperature)
+    generate_b = None
     if FLAGS.model == "lm":
         gen_metrics = ServingMetrics(logger, engine, name="generate",
                                      emit_every=FLAGS.serve_metrics_every,
                                      profiler=profiler)
-        client.generate_batcher = DynamicBatcher(
+        generate_b = DynamicBatcher(
             make_generate_runner(engine), group_key=generate_group_key,
             latency=StreamingHistogram(),
             on_batch=gen_metrics.on_batch,
             name="generate", **common)
+    # both batchers ride the CONSTRUCTOR: a post-construction attribute
+    # write would race HTTP handler threads already reading the client
+    # once the server starts (dttsan SAN002)
+    client = InProcessClient(
+        predict_batcher=predict_b,
+        generate_batcher=generate_b,
+        default_max_new_tokens=FLAGS.serve_max_new_tokens,
+        max_new_tokens_cap=FLAGS.serve_max_new_tokens,
+        default_temperature=FLAGS.serve_temperature)
 
     watcher = None
     if FLAGS.serve_reload_secs > 0:

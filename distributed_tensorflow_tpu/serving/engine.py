@@ -75,10 +75,21 @@ class InferenceEngine:
         self.input_dtype = (np.int32 if hasattr(model, "vocab_size")
                             else np.float32)
         self._swap_lock = threading.Lock()
+        # one reload in flight at a time: the watcher tick and a
+        # check_now() caller racing each other could both restore, and
+        # the slower (older) restore would swap AFTER the newer one —
+        # serving a version regression. The swap lock stays the cheap
+        # read-side guard; this serializes the whole restore+swap.
+        self._reload_lock = threading.Lock()
+        self._fn_lock = threading.Lock()
         self._apply_cache: dict = {}
         self._decode_cache: dict = {}
         self._params = None
         self._step = -1
+        # counters are mutated on the watcher thread (_reload) and read
+        # from HTTP handler threads (/metrics, /stats) and the batcher
+        # worker (ServingMetrics) — guarded by _swap_lock like _step;
+        # readers take counters_snapshot()
         self.counters = {"reloads": 0, "reload_failures": 0,
                          "reload_fallbacks": 0, "last_reload_ms": 0.0,
                          "last_fallback_depth": 0}
@@ -152,7 +163,15 @@ class InferenceEngine:
 
     @property
     def step(self) -> int:
-        return self._step
+        with self._swap_lock:
+            return self._step
+
+    def counters_snapshot(self) -> dict:
+        """One consistent copy of the reload counters — what /metrics,
+        /stats and the serving scalar cadence read while the watcher
+        thread reloads."""
+        with self._swap_lock:
+            return dict(self.counters)
 
     def _bucket(self, n: int) -> int:
         from distributed_tensorflow_tpu.serving.batcher import pow2_bucket
@@ -166,18 +185,22 @@ class InferenceEngine:
         input buffer is DONATED only when it can alias an output
         (float inputs; an int32 token batch can never alias the float
         logits, and a dead donation just warns per compile)."""
-        fn = self._apply_cache.get("apply")
-        if fn is None:
-            if self.jit:
-                import jax
+        with self._fn_lock:
+            # the fill races the batcher worker against a direct caller
+            # (tests/bench); double-checked so two threads can't build
+            # two wrappers and split the per-shape executable cache
+            fn = self._apply_cache.get("apply")
+            if fn is None:
+                if self.jit:
+                    import jax
 
-                donate = ((1,) if np.issubdtype(self.input_dtype,
-                                                np.floating) else ())
-                fn = jax.jit(lambda p, x: self.model.apply(p, x),
-                             donate_argnums=donate)
-            else:
-                fn = lambda p, x: self.model.apply(p, x)
-            self._apply_cache["apply"] = fn
+                    donate = ((1,) if np.issubdtype(self.input_dtype,
+                                                    np.floating) else ())
+                    fn = jax.jit(lambda p, x: self.model.apply(p, x),
+                                 donate_argnums=donate)
+                else:
+                    fn = lambda p, x: self.model.apply(p, x)
+                self._apply_cache["apply"] = fn
         return fn
 
     def predict(self, x) -> np.ndarray:
@@ -239,11 +262,12 @@ class InferenceEngine:
         # length or bucket — jax.jit specializes per input shape inside
         # the single wrapper, and a per-key wrapper would recompile the
         # same executable for every new prompt length
-        fns = self._decode_cache.get("decode")
-        if fns is None:
-            fns = (dec.make_prefill(self.model, jit=self.jit),
-                   dec.make_decode_step(self.model, jit=self.jit))
-            self._decode_cache["decode"] = fns
+        with self._fn_lock:
+            fns = self._decode_cache.get("decode")
+            if fns is None:
+                fns = (dec.make_prefill(self.model, jit=self.jit),
+                       dec.make_decode_step(self.model, jit=self.jit))
+                self._decode_cache["decode"] = fns
         params, _ = self.current()
         rng = None
         if temperature > 0.0:
@@ -266,16 +290,23 @@ class InferenceEngine:
         it through the fallback ladder and atomically swap. Returns a
         report dict, or None when there was nothing newer. NEVER raises
         on a corrupt newest set — the ladder walks back and the engine
-        keeps serving (a reload must not take down live traffic)."""
-        found = latest_checkpoint(self.logdir)
-        if found is None or found[1] <= self._step:
-            return None
-        path, step = found
-        with trace_span("serve_reload", step=step):
-            return self._reload(path, step)
+        keeps serving (a reload must not take down live traffic).
+
+        Serialized: the watcher tick and a ``check_now()`` caller racing
+        each other would both restore the same step (twice the restore
+        IO under live traffic), and the slower restore could swap an
+        OLDER params set over a newer one."""
+        with self._reload_lock:
+            found = latest_checkpoint(self.logdir)
+            if found is None or found[1] <= self.step:
+                return None
+            path, step = found
+            with trace_span("serve_reload", step=step):
+                return self._reload(path, step)
 
     def _reload(self, path: str, step: int) -> dict | None:
         t0 = time.monotonic()
+        serving = self.step
         try:
             fault_point("serve_reload", path=path, step=step)
             out = restore_params_with_fallback(self.logdir,
@@ -283,24 +314,28 @@ class InferenceEngine:
         except Exception as e:
             # ladder exhausted (CheckpointCorruptError), injected error,
             # unreadable directory: keep serving what we have
-            self.counters["reload_failures"] += 1
+            with self._swap_lock:
+                self.counters["reload_failures"] += 1
             print(f"serving reload failed (still serving step "
-                  f"{self._step}): {type(e).__name__}: {e}")
-            return {"swapped": False, "error": str(e), "step": self._step}
+                  f"{serving}): {type(e).__name__}: {e}")
+            return {"swapped": False, "error": str(e), "step": serving}
         ms = (time.monotonic() - t0) * 1e3
         if out is None:
-            self.counters["reload_failures"] += 1
+            with self._swap_lock:
+                self.counters["reload_failures"] += 1
             return {"swapped": False, "error": "no restorable checkpoint",
-                    "step": self._step}
+                    "step": serving}
         params, rstep, report = out
-        self.counters["last_fallback_depth"] = report.fallback_depth
-        if rstep <= self._step:
+        if rstep <= serving:
             # the newest set was corrupt; the ladder landed on (at or
             # below) what we already serve — count it, swap nothing
-            self.counters["reload_fallbacks"] += 1
+            with self._swap_lock:
+                self.counters["last_fallback_depth"] = \
+                    report.fallback_depth
+                self.counters["reload_fallbacks"] += 1
             print(f"serving reload: newest checkpoint (step {step}) "
                   f"failed verification; ladder landed on step {rstep} "
-                  f"— still serving step {self._step}")
+                  f"— still serving step {serving}")
             return {"swapped": False, "step": rstep,
                     "fallback_depth": report.fallback_depth,
                     "reload_ms": ms}
@@ -308,8 +343,9 @@ class InferenceEngine:
         with self._swap_lock:
             self._params = placed
             self._step = rstep
-        self.counters["reloads"] += 1
-        self.counters["last_reload_ms"] = ms
+            self.counters["last_fallback_depth"] = report.fallback_depth
+            self.counters["reloads"] += 1
+            self.counters["last_reload_ms"] = ms
         print(f"serving hot-reload: now serving step {rstep} "
               f"(restore+place {ms:.1f} ms, fallback depth "
               f"{report.fallback_depth})")
@@ -317,41 +353,61 @@ class InferenceEngine:
                 "fallback_depth": report.fallback_depth}
 
     def stats(self) -> dict:
-        return {"step": self._step, **self.counters}
+        with self._swap_lock:
+            return {"step": self._step, **self.counters}
 
 
 class CheckpointWatcher:
     """Polls the logdir every ``interval_s`` and hot-swaps through
     ``engine.reload_if_newer`` — TF-Serving's file-system monitor in one
     daemon thread. ``check_now()`` runs one tick synchronously (tests
-    and the bench drive it directly)."""
+    and the bench drive it directly; the engine serializes it against a
+    concurrent watcher tick).
+
+    The stop/start handoff is explicit: each ``start()`` hands its
+    thread a FRESH stop event, so ``start()`` after ``close()`` launches
+    a live watcher instead of one that observes the previous run's set
+    event and exits immediately (the silently-dead-watcher race dttsan
+    SAN004 now proves absent), and a close() racing a slow in-flight
+    reload can never be un-stopped by a concurrent restart."""
 
     def __init__(self, engine: InferenceEngine, interval_s: float = 10.0):
         self.engine = engine
         self.interval_s = float(interval_s)
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def start(self):
-        if self._thread is None:
-            self._thread = threading.Thread(target=self._loop,
-                                            name="serve-ckpt-watcher",
-                                            daemon=True)
-            self._thread.start()
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop = threading.Event()
+                self._thread = threading.Thread(
+                    target=self._loop, args=(self._stop,),
+                    name="serve-ckpt-watcher", daemon=True)
+                self._thread.start()
         return self
 
     def check_now(self) -> dict | None:
         return self.engine.reload_if_newer()
 
-    def _loop(self):
-        while not self._stop.wait(self.interval_s):
+    def _loop(self, stop: threading.Event):
+        # the event is an ARGUMENT, not read off self: a restart points
+        # self._stop at a fresh event for the new thread, and this one
+        # keeps honoring the event close() actually set for it
+        while not stop.wait(self.interval_s):
             try:
                 self.engine.reload_if_newer()
             except Exception as e:  # the watcher must outlive bad ticks
                 print(f"checkpoint watcher tick failed: {e}")
 
     def close(self):
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
-            self._thread = None
+        with self._lock:
+            self._stop.set()
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10)
+            if thread.is_alive():
+                print("checkpoint watcher still inside a reload after "
+                      "10s; abandoning the daemon thread (its stop "
+                      "event is set — it exits after the tick)")

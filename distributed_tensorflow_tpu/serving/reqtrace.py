@@ -396,6 +396,8 @@ class RequestPlane:
             snapshot = {key: dict(hists)
                         for key, hists in self._hists.items()}
             ring = list(self.audit)
+            requests_total = self.requests_total
+            by_disposition = dict(self.by_disposition)
         routes: dict = {}
         for (route, bucket), hists in sorted(snapshot.items()):
             entry: dict = {"phases": {}}
@@ -425,8 +427,16 @@ class RequestPlane:
                 "phases_ms": pm,
             })
         return {"routes": routes, "exemplars": exemplars,
-                "requests_total": self.requests_total,
-                "by_disposition": dict(self.by_disposition)}
+                "requests_total": requests_total,
+                "by_disposition": by_disposition}
+
+    def audit_snapshot(self) -> list[dict]:
+        """One consistent copy of the audit ring — what offline readers
+        (bench, req_report via the span files' sibling) iterate while
+        batcher/expiry threads keep finishing requests; iterating the
+        live deque would race their appends."""
+        with self._lock:
+            return list(self.audit)
 
     def slo_report(self) -> dict | None:
         return self.slo.report() if self.slo is not None else None

@@ -41,6 +41,7 @@ from distributed_tensorflow_tpu.serving.batcher import (
     DynamicBatcher,
     RejectedError,
 )
+from distributed_tensorflow_tpu.serving.engine import InferenceEngine
 
 
 def _result_with_id(fut, wait_s: float):
@@ -147,7 +148,7 @@ class InProcessClient:
         return out, _future_meta(fut)
 
 
-def make_predict_runner(engine):
+def make_predict_runner(engine: InferenceEngine):
     """Batcher runner for the predict route: stack the per-request
     examples, one engine call, unstack."""
 
@@ -159,7 +160,7 @@ def make_predict_runner(engine):
     return runner
 
 
-def make_generate_runner(engine):
+def make_generate_runner(engine: InferenceEngine):
     """Batcher runner for the generate route. Requests are grouped by
     (prompt length, max_new_tokens, temperature) — see
     ``generate_group_key`` — so one engine call serves the whole
@@ -207,7 +208,8 @@ class ServingMetrics:
     batchers' ``on_batch`` hook; also drives the optional profiler-trace
     capture (``--serve_profile_batches``)."""
 
-    def __init__(self, logger, engine, *, emit_every: int = 50,
+    def __init__(self, logger, engine: InferenceEngine, *,
+                 emit_every: int = 50,
                  profiler=None, name: str = ""):
         self.logger = logger
         self.engine = engine
@@ -253,14 +255,14 @@ class ServingMetrics:
             self._t0 = time.monotonic()
             self._last_count = done
         p = self.prefix
+        reloads = self.engine.counters_snapshot()
         scalars = {
             f"{p}queue_depth": float(stats["queue_depth"]),
             f"{p}throughput_rps": rps,
             f"{p}rejected_full": float(stats["rejected_full"]),
             f"{p}rejected_deadline": float(stats["rejected_deadline"]),
-            f"{p}reloads": float(self.engine.counters["reloads"]),
-            f"{p}reload_failures": float(
-                self.engine.counters["reload_failures"]),
+            f"{p}reloads": float(reloads["reloads"]),
+            f"{p}reload_failures": float(reloads["reload_failures"]),
         }
         if batcher.latency is not None:
             scalars.update(batcher.latency.summary(f"{p}latency_ms_"))
@@ -367,7 +369,8 @@ class _Handler(BaseHTTPRequestHandler):
 class InferenceServer:
     """ThreadingHTTPServer wrapper owning the route -> batcher wiring."""
 
-    def __init__(self, engine, client: InProcessClient,
+    def __init__(self, engine: InferenceEngine,
+                 client: InProcessClient,
                  host: str = "127.0.0.1", port: int = 8000,
                  resources_monitor=None,
                  hbm_headroom_floor_pct: float = 0.0):
@@ -544,13 +547,14 @@ class InferenceServer:
         ``goodput_uptime_pct`` plus a per-batcher ``health`` block
         (p99 trend between polls, saturation streak)."""
         eng = self.engine
+        reloads = eng.counters_snapshot()
         out = {
             "params_step": eng.step,
-            "reloads": eng.counters["reloads"],
-            "reload_failures": eng.counters["reload_failures"],
-            "reload_fallbacks": eng.counters["reload_fallbacks"],
-            "last_reload_ms": eng.counters["last_reload_ms"],
-            "last_fallback_depth": eng.counters["last_fallback_depth"],
+            "reloads": reloads["reloads"],
+            "reload_failures": reloads["reload_failures"],
+            "reload_fallbacks": reloads["reload_fallbacks"],
+            "last_reload_ms": reloads["last_reload_ms"],
+            "last_fallback_depth": reloads["last_fallback_depth"],
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "goodput_uptime_pct": self._goodput_uptime_pct(),
         }

@@ -732,7 +732,13 @@ class CompileSentry:
         self.storms = 0
         self._sites: dict = {}       # site -> {sig: hits}
         self._last_sig: dict = {}    # site -> most recent signature
-        self._recent: deque = deque()  # (t, site, delta) recompiles
+        # (t, site, delta) recompiles inside the storm window. Bounded
+        # BY CONSTRUCTION (dttsan SAN004): the window-pruning loop in
+        # observe() keeps it small in practice, but a monitoring ring
+        # must not rely on pruning logic for its bound — budget+1 is
+        # exactly enough for len > budget to trip the storm report
+        self._recent: deque = deque(
+            maxlen=(self.budget + 1) if self.budget else 1024)
         self.last_delta: str | None = None
 
     def on_compile_event(self, event: str, dur: float) -> None:

@@ -130,6 +130,7 @@ class Tracer:
         self._local = threading.local()
         self._path: str | None = None
         self._file = None
+        self._file_path: str | None = None  # path _file was opened for
 
     def _stack(self) -> list:
         st = getattr(self._local, "stack", None)
@@ -179,12 +180,15 @@ class Tracer:
         """Set (or clear) the spans JSONL file; flushes are batched —
         the loops call ``flush()`` at the display cadence and every
         flight-recorder dump flushes too."""
+        with self._lock:
+            # _path reads/writes stay under _lock (the writers' lock);
+            # the file handle swap alone rides _io_lock
+            self._path = path
         with self._io_lock:
-            if self._file is not None and path != self._path:
+            if self._file is not None and path != self._file_path:
                 self._file.close()
                 self._file = None
-        with self._lock:
-            self._path = path
+                self._file_path = None
 
     def flush(self) -> None:
         """Write pending spans to the JSONL sink (batched: the hot path
@@ -196,10 +200,19 @@ class Tracer:
             path = self._path
         with self._io_lock:
             try:
+                # the handle must match the path THIS flush snapshotted:
+                # a configure_sink racing in between could otherwise
+                # leave the handle bound to the OLD path and every later
+                # flush would misdirect spans into the previous run's
+                # file (the new sink staying silently empty)
+                if self._file is not None and self._file_path != path:
+                    self._file.close()
+                    self._file = None
                 if self._file is None:
                     os.makedirs(os.path.dirname(path) or ".",
                                 exist_ok=True)
                     self._file = open(path, "a")
+                    self._file_path = path
                 for rec in pending:
                     self._file.write(json.dumps(
                         {k: _json_safe(v) for k, v in rec.items()}) + "\n")
@@ -543,7 +556,8 @@ class FlightRecorder:
 
     @property
     def path(self) -> str | None:
-        return self._path
+        with self._lock:
+            return self._path
 
     def _install(self) -> None:
         with self._lock:
@@ -581,7 +595,9 @@ class FlightRecorder:
             # don't downgrade a real postmortem: if a crash/watchdog/
             # excepthook dump already wrote the file, the clean-shutdown
             # rewrite would replace its meta reason with "atexit"
-            if self.last_dump is None:
+            with self._lock:
+                dumped = self.last_dump
+            if dumped is None:
                 self.dump("atexit")
         except Exception:
             pass
@@ -620,7 +636,10 @@ class FlightRecorder:
         except OSError as e:
             print(f"telemetry: flight-recorder dump failed: {e}")
             return None
-        self.last_dump = reason
+        # last_dump is the watchdog-vs-excepthook-vs-atexit arbitration
+        # state — same lock as configure()'s reset
+        with self._lock:
+            self.last_dump = reason
         return path
 
 

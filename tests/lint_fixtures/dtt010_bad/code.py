@@ -1,0 +1,21 @@
+"""DTT010 bad fixture: one inventory-resolvable Thread, one that is
+NOT (its target is an arbitrary callable value the AST cannot name)."""
+import threading
+
+
+class Covered:
+    def start(self):
+        # resolvable: a self-method target — the inventory names it
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def _loop(self):
+        pass
+
+
+def launch(fn):
+    # NOT resolvable: `fn` is a parameter, not a def — the finding
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    kill = threading.Timer(5.0, fn)
+    kill.cancel()

@@ -1,0 +1,2 @@
+"""Fixture dttsan presence marker: the self-disable guard checks the
+walk set contains tools/dttsan/ sources, not this file's content."""

@@ -1,0 +1,11 @@
+"""SAN001 fixture: one thread root — registered or not depending on
+which registry the test hands dttsan."""
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        pass

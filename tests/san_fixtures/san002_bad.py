@@ -1,0 +1,31 @@
+"""SAN002 bad fixture: shared attributes violating lock-set
+discipline three ways — an unguarded write, writes under DIFFERENT
+locks, and a lock-free read of a lock-guarded counter."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._other = threading.Lock()
+        self.count = 0          # written under two different locks
+        self.naked = 0          # written with no lock at all
+        self.guarded = 0        # written under _lock, read lock-free
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self.count += 1
+                self.guarded += 1
+            self.naked += 1
+
+    def bump(self):
+        # caller-thread write under the WRONG lock
+        with self._other:
+            self.count += 1
+        self.naked += 1
+
+    def peek(self):
+        return self.guarded  # lock-free read of a guarded attr

@@ -1,0 +1,24 @@
+"""SAN002 good fixture: the same shape with ONE common lock over every
+write and read — clean."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def peek(self):
+        with self._lock:
+            return self.count

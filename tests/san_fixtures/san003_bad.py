@@ -1,0 +1,44 @@
+"""SAN003 bad fixture: the lock-order/CV-discipline violations — an
+AB-BA acquisition cycle, a bare wait (no while predicate), a notify
+without holding, a blocking sleep under a lock, and a wait that keeps a
+SECOND lock held through it."""
+import time
+import threading
+
+
+class Deadlocky:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cv = threading.Condition()
+        self.items = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._a:       # A -> B
+                with self._b:
+                    pass
+
+    def backwards(self):
+        with self._b:           # B -> A: the cycle
+            with self._a:
+                pass
+
+    def bad_wait(self):
+        with self._cv:
+            self._cv.wait()     # no while predicate around it
+
+    def bad_notify(self):
+        self._cv.notify_all()   # not holding the condition
+
+    def slow_under_lock(self):
+        with self._a:
+            time.sleep(0.5)     # blocking with _a held
+
+    def wait_holding_other(self):
+        with self._b:
+            with self._cv:
+                while not self.items:
+                    self._cv.wait()  # _b stays held through the wait

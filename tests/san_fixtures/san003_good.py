@@ -1,0 +1,42 @@
+"""SAN003 good fixture: consistent acquisition order, wait inside a
+while predicate holding only its own condition, notify while holding,
+no blocking work under any lock."""
+import time
+import threading
+
+
+class Orderly:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cv = threading.Condition()
+        self.items = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._a:       # only ever A -> B
+                with self._b:
+                    pass
+
+    def forwards(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def consume(self):
+        with self._cv:
+            while not self.items:
+                self._cv.wait()
+            return self.items.pop()
+
+    def produce(self, x):
+        with self._cv:
+            self.items.append(x)
+            self._cv.notify_all()
+
+    def slow_then_lock(self):
+        time.sleep(0.01)        # the sleep happens OUTSIDE the lock
+        with self._a:
+            pass

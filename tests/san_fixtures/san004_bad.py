@@ -1,0 +1,41 @@
+"""SAN004 bad fixture: lifecycle violations — a restartable start()
+reusing a set stop Event (the CheckpointWatcher class of bug), an
+UNBOUNDED deque ring appended from a thread, and a non-daemon thread
+nobody ever joins."""
+import threading
+from collections import deque
+
+
+class Restartable:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._ring: deque = deque()   # no maxlen: unbounded ring
+        self._thread = None
+        self._lock = threading.Lock()
+
+    def start(self):
+        # BUG: after close() set the event, this restarts a thread that
+        # observes it still set and exits immediately
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(0.1):
+            with self._lock:
+                self._ring.append(1)
+
+    def close(self):
+        self._stop.set()
+
+
+def leak(job):
+    # non-daemon, never joined: outlives the run
+    t = threading.Thread(target=job_runner)
+    t.start()
+
+
+def job_runner():
+    pass

@@ -1,0 +1,37 @@
+"""SAN004 good fixture: the same shapes done right — a fresh stop
+Event per start(), a maxlen-bounded ring, a daemon thread."""
+import threading
+from collections import deque
+
+
+class Restartable:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._ring: deque = deque(maxlen=256)
+        self._thread = None
+        self._lock = threading.Lock()
+
+    def start(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop = threading.Event()  # fresh per start
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(0.1):
+            with self._lock:
+                self._ring.append(1)
+
+    def close(self):
+        self._stop.set()
+
+
+def launch(job):
+    t = threading.Thread(target=job_runner, daemon=True)
+    t.start()
+
+
+def job_runner():
+    pass

@@ -327,9 +327,15 @@ def test_degraded_record_keeps_schedule_facts_non_null():
     # (dttlint is pure ast, no backend at all) — asserted here instead
     # of paying a second full degraded_record build
     assert rec["lint_findings_total"] == 0
-    assert rec["lint_rules"] == 9
+    assert rec["lint_rules"] == 10
     assert rec["lint_baselined_total"] is not None
     assert rec["lint_time_s"] is not None
+    # r20: the concurrency-proof facts ride the degraded record too
+    # (dttsan is pure ast like dttlint — no backend at all)
+    assert rec["consan_findings_total"] == 0
+    assert rec["consan_threads_total"] > 0
+    assert rec["consan_locks_total"] > 0
+    assert rec["consan_time_s"] is not None
     # r18: the jaxpr-proof facts ride the degraded record too (the
     # dttcheck drill runs in its own CPU-mesh subprocess, no backend
     # dependence; per-process cache makes this ride-along free here)
@@ -486,15 +492,34 @@ def test_overlap_phase_skips_on_one_chip(ds):
 
 def test_lint_phase_runs_clean_and_fast():
     """r16: the dttlint drill — zero non-baselined findings with the
-    checked-in baseline, all nine rules (DTT009 since r18), inside the
-    <10s acceptance budget (pure ast, no chip)."""
+    checked-in baseline, all ten rules (DTT009 since r18, DTT010 since
+    r20), inside the <10s acceptance budget (pure ast, no chip)."""
     out = bench.lint_phase()
     assert out["lint_findings_total"] == 0, out
     assert out["lint_stale_suppressions"] == 0
-    assert out["lint_rules"] == 9
+    assert out["lint_rules"] == 10
     assert out["lint_baselined_total"] >= 0
     assert out["lint_time_s"] < 10.0
     assert "lint_error" not in out
+    # the degraded-record ride-along is asserted in
+    # test_degraded_record_keeps_schedule_facts_non_null (one shared
+    # degraded_record build instead of two)
+
+
+def test_consan_phase_runs_clean_and_fast():
+    """r20: the dttsan drill — zero non-baselined findings (stale
+    suppressions count as findings here: either way the gate is dirty)
+    with the checked-in baseline + thread registry, inside the <15s
+    acceptance budget (pure ast, no chip), with the thread/lock census
+    non-null."""
+    out = bench.consan_phase()
+    assert out["consan_findings_total"] == 0, out
+    assert out["consan_threads_total"] > 0
+    assert out["consan_locks_total"] > 0
+    assert out["consan_shared_attrs"] > 0
+    assert out["consan_baselined_total"] >= 0
+    assert out["consan_time_s"] < 15.0
+    assert "consan_error" not in out
     # the degraded-record ride-along is asserted in
     # test_degraded_record_keeps_schedule_facts_non_null (one shared
     # degraded_record build instead of two)

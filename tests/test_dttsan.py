@@ -1,0 +1,474 @@
+"""dttsan — the static concurrency analyzer (tools/dttsan/).
+
+Four layers: (1) per-pass fixture pairs — one minimal violating
+snippet, one conforming — under tests/san_fixtures/; (2) the REPO-WIDE
+run: zero non-baselined findings with the checked-in baseline and
+registry, inside the <15s acceptance budget, with registry drift
+failing BOTH directions; (3) the CLI surface (--json, exit codes,
+--threads); (4) regression tests for the real concurrency bugs
+dttsan's bring-up surfaced and fixed (the CheckpointWatcher stop/
+restart race, unserialized engine reloads + unguarded counters, the
+unbounded CompileSentry recompile ring, the Checkpointer pending-error
+read outside its cv)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.dttsan import (  # noqa: E402
+    ALL_PASSES,
+    run_san,
+    threads_table,
+)
+from tools.dttsan.inventory import discover_roots  # noqa: E402
+
+FIXTURES = os.path.join(REPO, "tests", "san_fixtures")
+
+_EMPTY_BASELINE = os.path.join(FIXTURES, "empty_baseline.json")
+_EMPTY_REGISTRY = os.path.join(FIXTURES, "empty_registry.json")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def empty_files():
+    for path in (_EMPTY_BASELINE, _EMPTY_REGISTRY):
+        with open(path, "w") as f:
+            json.dump({"version": 1, "entries": []}, f)
+    yield
+    for path in (_EMPTY_BASELINE, _EMPTY_REGISTRY):
+        os.remove(path)
+
+
+def _san(root, targets, registry=None):
+    return run_san(
+        root=os.path.join(FIXTURES, root) if root else FIXTURES,
+        baseline_path=_EMPTY_BASELINE, targets=targets,
+        registry_path=registry or _EMPTY_REGISTRY)
+
+
+def _keys(res, rule):
+    return sorted(f.key for f in res.findings if f.rule == rule)
+
+
+# ---------------------------------------------------- per-pass fixtures
+
+
+def test_san001_registry_drift_both_directions():
+    """Orphan (discovered root missing from the registry) AND phantom
+    (registry entry with no discovered root) both fail — the registry
+    tracks live concurrency exactly."""
+    root = os.path.join(FIXTURES, "san001_registry")
+    orphan = _san("san001_registry", ("code.py",))
+    assert _keys(orphan, "SAN001") == [
+        "thread:code.py:Poller.__init__:self._loop"]
+    assert "unregistered" in orphan.findings[0].message
+    clean = _san("san001_registry", ("code.py",),
+                 registry=os.path.join(root, "registry_good.json"))
+    assert clean.findings == [], [f.format() for f in clean.findings]
+    phantom = _san("san001_registry", ("code.py",),
+                   registry=os.path.join(root, "registry_bad.json"))
+    msgs = {f.key: f.message for f in phantom.findings
+            if f.rule == "SAN001"}
+    assert "thread:code.py:Poller.__init__:self._gone_loop" in msgs
+    assert "phantom" in msgs[
+        "thread:code.py:Poller.__init__:self._gone_loop"]
+
+
+def test_san001_registry_entries_require_notes():
+    from tools.dttsan.inventory import load_registry
+
+    bad = os.path.join(FIXTURES, "noteless.json")
+    with open(bad, "w") as f:
+        json.dump({"version": 1, "entries": [{"key": "thread:x:y:z"}]},
+                  f)
+    try:
+        with pytest.raises(ValueError, match="note"):
+            load_registry(bad)
+    finally:
+        os.remove(bad)
+
+
+def test_san002_fixture_pair():
+    bad = _san("", ("san002_bad.py",))
+    keys = _keys(bad, "SAN002")
+    assert "san002_bad.py:Worker.naked:unguarded-write" in keys
+    assert "san002_bad.py:Worker.count:mixed-locks" in keys
+    assert "san002_bad.py:Worker.guarded:unguarded-read" in keys
+    good = _san("", ("san002_good.py",))
+    assert _keys(good, "SAN002") == []
+
+
+def test_san003_fixture_pair():
+    bad = _san("", ("san003_bad.py",))
+    keys = _keys(bad, "SAN003")
+    assert any(k.startswith("lock-cycle:") for k in keys), keys
+    assert any("wait-no-while" in k and "bad_wait" in k for k in keys)
+    assert any("notify-unheld" in k and "bad_notify" in k for k in keys)
+    assert any("blocking-held" in k and "slow_under_lock" in k
+               for k in keys)
+    assert any("wait-holding" in k and "wait_holding_other" in k
+               for k in keys)
+    good = _san("", ("san003_good.py",))
+    assert _keys(good, "SAN003") == []
+
+
+def test_san004_fixture_pair():
+    bad = _san("", ("san004_bad.py",))
+    keys = _keys(bad, "SAN004")
+    assert any("stop-reuse" in k and "Restartable.start" in k
+               for k in keys), keys
+    assert any("ring-unbounded" in k and "_ring" in k for k in keys)
+    assert any("thread-hygiene" in k and "leak" in k for k in keys)
+    good = _san("", ("san004_good.py",))
+    assert _keys(good, "SAN004") == []
+
+
+def test_inventory_discovers_every_root_kind():
+    """The repo walk must see every kind the registry carries: threads,
+    timers, handler classes, excepthook/atexit/signal hooks, and crash
+    contexts — the Supervisor-parity thread plane enumerated."""
+    from tools.dttlint import RepoIndex
+
+    roots, _bad = discover_roots(RepoIndex(REPO))
+    kinds = {r.kind for r in roots}
+    assert {"thread", "timer", "handler", "excepthook", "atexit",
+            "signal", "crash"} <= kinds
+    keys = {r.key for r in roots}
+    # the load-bearing roots by name (a rename must be a conscious act)
+    for needle in ("DynamicBatcher.__init__:self._worker_loop",
+                   "DynamicBatcher.__init__:self._expiry_loop",
+                   "CheckpointWatcher.start:self._loop",
+                   "Checkpointer._submit_flat:self._writer_loop",
+                   "prefetch_to_device:_worker",
+                   "Watchdog.arm:self._loop",
+                   "Supervisor._install_signal_handlers:_handler"):
+        assert any(needle in k for k in keys), needle
+
+
+# ------------------------------------------------------- repo-wide run
+
+
+def test_repo_is_race_free_with_checked_in_baseline():
+    """THE gate: the whole walk set has zero non-baselined findings,
+    zero stale suppressions, and zero registry drift, inside the <15s
+    acceptance budget — every baseline entry still matches a real
+    finding and carries its reason."""
+    t0 = time.perf_counter()
+    res = run_san()
+    dt = time.perf_counter() - t0
+    assert res.findings == [], \
+        "new findings:\n" + "\n".join(f.format() for f in res.findings)
+    assert res.stale == [], res.stale
+    assert tuple(res.rules) == ALL_PASSES
+    assert dt < 15.0, f"dttsan took {dt:.1f}s (>15s acceptance budget)"
+    assert res.baselined, "baseline is empty — update this test if " \
+                          "the tree went fully clean"
+    from tools.dttsan import load_baseline
+
+    entries = load_baseline()
+    assert all(e["reason"] for e in entries)
+    assert {(f.rule, f.key) for f in res.baselined} == \
+        {(e["rule"], e["key"]) for e in entries}
+    # the report facts bench's consan_phase emits
+    assert res.report["threads_total"] > 0
+    assert res.report["locks_total"] > 0
+    assert res.report["shared_attrs"] > 0
+
+
+def test_repo_registry_drift_fails_both_directions(tmp_path):
+    """Against the REAL tree: a registry missing one live root fails
+    (orphan), and one carrying an extra dead key fails (phantom)."""
+    real = json.load(open(os.path.join(REPO, "tools", "dttsan",
+                                       "registry.json")))
+    entries = real["entries"]
+    missing = tmp_path / "missing.json"
+    json.dump({"version": 1, "entries": entries[1:]}, open(missing, "w"))
+    res = run_san(registry_path=str(missing))
+    assert any(f.rule == "SAN001" and entries[0]["key"] == f.key
+               for f in res.findings)
+    extra = tmp_path / "extra.json"
+    json.dump({"version": 1, "entries": entries + [
+        {"key": "thread:no/such/file.py:Gone.start:self._loop",
+         "note": "a thread that was deleted"}]}, open(extra, "w"))
+    res = run_san(registry_path=str(extra))
+    assert any(f.rule == "SAN001" and "phantom" in f.message
+               for f in res.findings)
+
+
+def test_stale_suppression_fails_loudly(tmp_path):
+    base = tmp_path / "baseline.json"
+    real = json.load(open(os.path.join(REPO, "tools", "dttsan",
+                                       "baseline.json")))
+    base.write_text(json.dumps({"version": 1, "entries":
+                                real["entries"] + [
+        {"rule": "SAN002",
+         "key": "no/such/file.py:Gone.attr:unguarded-write",
+         "reason": "left over from deleted code"}]}))
+    res = run_san(baseline_path=str(base))
+    assert not res.ok
+    assert res.stale == [
+        "SAN002:no/such/file.py:Gone.attr:unguarded-write"]
+
+
+def test_baseline_reason_is_mandatory(tmp_path):
+    from tools.dttsan import load_baseline
+
+    base = tmp_path / "noreason.json"
+    base.write_text(json.dumps({"version": 1, "entries": [
+        {"rule": "SAN002", "key": "x"}]}))
+    with pytest.raises(ValueError, match="reason"):
+        load_baseline(str(base))
+
+
+def test_finding_keys_are_line_number_free():
+    res = _san("", ("san002_bad.py", "san003_bad.py", "san004_bad.py"))
+    import re
+
+    for f in res.findings:
+        assert not re.search(r":\d+$", f.key), \
+            f"key {f.key!r} ends in what looks like a line number"
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.dttsan", *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_cli_exits_zero_and_emits_json():
+    p = _cli("--json")
+    assert p.returncode == 0, p.stdout + p.stderr
+    out = json.loads(p.stdout)
+    assert out["ok"] and out["findings"] == []
+    assert list(out["rules"]) == list(ALL_PASSES)
+    assert out["report"]["threads_total"] > 0
+
+
+def test_cli_exits_nonzero_on_stale(tmp_path):
+    base = tmp_path / "baseline.json"
+    real = json.load(open(os.path.join(REPO, "tools", "dttsan",
+                                       "baseline.json")))
+    base.write_text(json.dumps({"version": 1, "entries":
+                                real["entries"] + [
+        {"rule": "SAN002", "key": "gone", "reason": "stale"}]}))
+    p = _cli("--baseline", str(base))
+    assert p.returncode == 1
+    assert "STALE" in p.stdout
+
+
+def test_cli_threads_prints_the_inventory():
+    p = _cli("--threads")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "self._worker_loop" in p.stdout
+    assert "DynamicBatcher._cv" in p.stdout  # guarding-lock column
+    rows = threads_table()
+    worker = next(r for r in rows
+                  if r["target"] == "self._worker_loop")
+    assert "_queue" in worker["shared_attrs"]
+    assert any("_cv" in lk for lk in worker["locks"])
+
+
+def test_analyze_runs_all_three_with_one_exit_code():
+    """The umbrella: dttlint + dttcheck + dttsan, merged exit 0 on the
+    clean tree (dttcheck in its own CPU-mesh subprocess), < 30s."""
+    t0 = time.perf_counter()
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    dt = time.perf_counter() - t0
+    assert p.returncode == 0, p.stdout + p.stderr
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["ok"]
+    for name in ("dttlint", "dttcheck", "dttsan"):
+        assert out[name]["ok"], out[name]
+    assert dt < 30.0, f"analyze took {dt:.1f}s (>30s acceptance)"
+
+
+# --------------------------------------- regressions for the r20 fixes
+
+
+class _TinyModel:
+    """Host-only serving model: logits = x @ w + b (the bench shape)."""
+
+    @staticmethod
+    def apply(params, x):
+        return np.asarray(x) @ params["w"] + params["b"]
+
+
+def _engine(tmp_path, step=10):
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        save_checkpoint,
+    )
+    from distributed_tensorflow_tpu.serving.engine import InferenceEngine
+
+    rng = np.random.default_rng(0)
+    params = {"w": rng.standard_normal((8, 4)).astype(np.float32),
+              "b": np.zeros(4, np.float32)}
+    d = str(tmp_path / "ckpts")
+    save_checkpoint(d, {"params": params}, step)
+    return InferenceEngine(_TinyModel(), d, jit=False,
+                           params_template=params), d, params
+
+
+def test_watcher_restart_after_close_is_alive(tmp_path):
+    """The stop/restart race dttsan SAN004 named: close() then start()
+    used to launch a thread that observed the still-set stop event and
+    exited immediately — a silently dead watcher. A restarted watcher
+    must hot-swap again."""
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        save_checkpoint,
+    )
+    from distributed_tensorflow_tpu.serving.engine import (
+        CheckpointWatcher,
+    )
+
+    eng, d, params = _engine(tmp_path)
+    w = CheckpointWatcher(eng, interval_s=0.05).start()
+    first = w._thread
+    assert first is not None and first.is_alive()
+    w.close()
+    assert w._thread is None
+    w.start()
+    second = w._thread
+    assert second is not None and second.is_alive()
+    assert second is not first
+    # and it still does its job: a newer checkpoint gets swapped in
+    save_checkpoint(d, {"params": params}, 20)
+    deadline = time.monotonic() + 5.0
+    while eng.step < 20 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert eng.step == 20
+    w.close()
+
+
+def test_watcher_restart_uses_a_fresh_stop_event(tmp_path):
+    eng, _d, _p = _engine(tmp_path)
+    from distributed_tensorflow_tpu.serving.engine import (
+        CheckpointWatcher,
+    )
+
+    w = CheckpointWatcher(eng, interval_s=30.0).start()
+    ev1 = w._stop
+    w.close()
+    assert ev1.is_set()
+    w.start()
+    assert w._stop is not ev1 and not w._stop.is_set()
+    w.close()
+
+
+def test_concurrent_reloads_serialize_and_step_never_regresses(
+        tmp_path):
+    """The watcher tick racing check_now(): both used to restore
+    concurrently, and the slower (older) restore could swap AFTER a
+    newer one — a served-version regression. Reloads are serialized
+    now; under a hammering mix of writers and reloaders the served
+    step must be non-decreasing and land at the newest."""
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        save_checkpoint,
+    )
+
+    eng, d, params = _engine(tmp_path)
+    observed: list[int] = []
+    stop = threading.Event()
+    regressions: list[tuple] = []
+
+    def reloader():
+        last = -1
+        while not stop.is_set():
+            eng.reload_if_newer()
+            s = eng.step
+            if s < last:
+                regressions.append((last, s))
+            last = s
+            observed.append(s)
+
+    threads = [threading.Thread(target=reloader, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    for step in range(11, 31):
+        save_checkpoint(d, {"params": params}, step)
+    deadline = time.monotonic() + 10.0
+    while eng.step < 30 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert regressions == []
+    assert eng.step == 30
+    snap = eng.counters_snapshot()
+    assert snap["reloads"] >= 1
+    assert eng.stats()["step"] == 30
+
+
+def test_compile_sentry_ring_is_bounded():
+    """The recompile ring dttsan SAN004 named: deque() without maxlen
+    relied on pruning logic for its bound. Bounded by construction now
+    — and the storm report still trips (maxlen is budget+1, exactly
+    enough for len > budget)."""
+    from distributed_tensorflow_tpu.utils.resources import CompileSentry
+
+    snt = CompileSentry(budget=3, window_s=3600.0)
+    assert snt._recent.maxlen == 4
+    for i in range(8):
+        snt.observe("site", (i,))
+    assert snt.storms >= 1
+    assert len(snt._recent) <= snt._recent.maxlen
+    unbudgeted = CompileSentry(budget=0)
+    assert unbudgeted._recent.maxlen is not None
+
+
+def test_tracer_flush_rebinds_handle_after_sink_race(tmp_path):
+    """A configure_sink racing between flush()'s path snapshot and its
+    file write could leave the handle bound to the OLD path forever —
+    every later flush misdirecting spans into the previous run's file.
+    flush() now re-checks the handle's path against its snapshot."""
+    from distributed_tensorflow_tpu.utils.telemetry import Tracer
+
+    old = str(tmp_path / "run1" / "spans.jsonl")
+    new = str(tmp_path / "run2" / "spans.jsonl")
+    tr = Tracer()
+    tr.configure_sink(old)
+    with tr.span("warm"):
+        pass
+    tr.flush()  # binds the handle to run1
+    # the race's post state: _path moved on, handle still bound to old
+    tr.configure_sink(new)
+    os.makedirs(os.path.dirname(old), exist_ok=True)
+    tr._file = open(old, "a")
+    tr._file_path = old
+    with tr.span("after"):
+        pass
+    tr.flush()
+    assert "after" in open(new).read()
+    assert "after" not in open(old).read()
+    tr.configure_sink(None)
+
+
+def test_checkpointer_pending_error_read_under_cv(tmp_path):
+    """The lock-free test-then-clear of _error could drop a writer
+    error landing between the two; the read-and-clear now happens
+    under the cv and still surfaces exactly once."""
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        Checkpointer,
+    )
+
+    ck = Checkpointer(str(tmp_path / "ck"), background=True,
+                      save_model_secs=1)
+    err = RuntimeError("disk gone")
+    with ck._cv:
+        ck._error = err
+    with pytest.raises(RuntimeError, match="disk gone"):
+        ck._raise_pending_error()
+    ck._raise_pending_error()  # cleared: second call is quiet

@@ -26,6 +26,7 @@ from tools.dttlint.rules import (  # noqa: E402
     rule_donation_safety,
     rule_fault_registry,
     rule_flag_validator,
+    rule_inventory_coverage,
     rule_ledger_coverage,
     rule_scalar_contract,
     rule_span_taxonomy,
@@ -74,6 +75,8 @@ FIXTURE_MATRIX = [
      "DTT008", 1),
     (rule_traced_coverage, "dtt009_bad",
      ("parallel/mod.py", "tools/dttcheck/refs.py"), None, "DTT009", 1),
+    (rule_inventory_coverage, "dtt010_bad",
+     ("code.py", "tools/dttsan/stub.py"), None, "DTT010", 2),
 ]
 
 
@@ -140,7 +143,7 @@ def test_repo_lints_clean_with_checked_in_baseline():
     assert res.findings == [], \
         "new findings:\n" + "\n".join(f.format() for f in res.findings)
     assert res.stale == [], res.stale
-    assert len(res.rules) == 9
+    assert len(res.rules) == 10
     assert dt < 10.0, f"lint took {dt:.1f}s (>10s acceptance budget)"
     assert res.baselined, "baseline is empty — update this test if " \
                           "the tree went fully clean"
@@ -185,7 +188,7 @@ def test_cli_exits_zero_and_emits_json():
     assert p.returncode == 0, p.stdout + p.stderr
     out = json.loads(p.stdout)
     assert out["ok"] and out["findings"] == []
-    assert len(out["rules"]) == 9
+    assert len(out["rules"]) == 10
 
 
 def test_cli_exits_nonzero_on_new_violation(tmp_path):
@@ -239,7 +242,7 @@ def test_scalar_contract_sees_all_loop_variants():
 
 def test_all_rules_registered():
     assert [r.rule_id for r in ALL_RULES] == [
-        f"DTT00{i}" for i in range(1, 10)]
+        f"DTT00{i}" for i in range(1, 10)] + ["DTT010"]
 
 
 def test_dtt009_names_the_orphan_and_guards_self_disable():
@@ -253,4 +256,19 @@ def test_dtt009_names_the_orphan_and_guards_self_disable():
     assert "machine-unproven" in res.findings[0].message
     res2 = _lint(rule_traced_coverage, "dtt009_bad", "parallel/mod.py")
     assert [f.rule for f in res2.findings] == ["DTT009"]
+    assert "self-disable" in res2.findings[0].message
+
+
+def test_dtt010_names_the_unresolvable_and_guards_self_disable():
+    """DTT010 (r20): the Thread/Timer whose target is an arbitrary
+    callable value is NAMED (the self-method one is inventory-covered
+    and stays quiet); a walk set with Thread sites but no tools/dttsan
+    sources is itself a finding."""
+    res = _lint(rule_inventory_coverage, "dtt010_bad",
+                "code.py", "tools/dttsan/stub.py")
+    assert [f.key for f in res.findings] == [
+        "code.py::launch:Thread", "code.py::launch:Timer"]
+    assert all("inventory" in f.message for f in res.findings)
+    res2 = _lint(rule_inventory_coverage, "dtt010_bad", "code.py")
+    assert [f.rule for f in res2.findings] == ["DTT010"]
     assert "self-disable" in res2.findings[0].message

@@ -38,6 +38,10 @@ docs/ARCHITECTURE.md "Static analysis"):
   DTT009 traced-coverage   every parallel/ collective call site is
                            reachable from a dttcheck-traced step
                            function (the jaxpr layer's closure rule)
+  DTT010 inventory-coverage  every threading.Thread/Timer construction
+                           site is discoverable by the dttsan thread
+                           inventory (the concurrency layer's closure
+                           rule)
 
 Run it: ``python -m tools.dttlint [--json] [--baseline PATH] [--fix]``.
 Exit 0 = no non-baselined findings and no stale suppressions; nonzero

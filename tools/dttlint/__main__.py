@@ -90,7 +90,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.dttlint",
         description="dttlint — the repo's AST invariant linter "
-                    "(rules DTT001-DTT009; see docs/ARCHITECTURE.md "
+                    "(rules DTT001-DTT010; see docs/ARCHITECTURE.md "
                     "'Static analysis')")
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-readable JSON object")

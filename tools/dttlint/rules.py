@@ -902,6 +902,66 @@ def rule_traced_coverage(index) -> list:
 rule_traced_coverage.rule_id = "DTT009"
 
 
+# ------------------------------------------- DTT010 inventory-coverage
+
+
+_DTTSAN_PREFIX = "tools/dttsan"
+
+
+def rule_inventory_coverage(index) -> list:
+    """DTT010: every ``threading.Thread``/``Timer`` construction site
+    must be dttsan-inventory-REACHABLE — discoverable by the thread
+    inventory with a statically-resolvable target (the r20 twin of
+    DTT009's traced-coverage rule: the AST and concurrency layers stay
+    closed under extension). A Thread whose target the inventory cannot
+    name is a concurrent root no pass can prove race-free, and one the
+    SAN001 registry can never pin. Self-disable guarded: Thread sites
+    with no tools/dttsan/ sources in the walk set are themselves a
+    finding."""
+    raw_sites = []  # (rel, qual, line, callee)
+    has_dttsan = any(rel.startswith(_DTTSAN_PREFIX)
+                     for rel in index.trees)
+    for rel, tree in index.trees.items():
+        for node, qual in _walk_scoped(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func) or ""
+            name = chain.rsplit(".", 1)[-1]
+            head = chain.rsplit(".", 1)[0] if "." in chain else ""
+            if name in ("Thread", "Timer") and head in ("", "threading"):
+                raw_sites.append((rel, qual, node.lineno, name))
+    if not raw_sites:
+        return []
+    if not has_dttsan:
+        return [Finding(
+            "DTT010", "tools::dttsan-missing", _DTTSAN_PREFIX, 0,
+            "the walk set contains threading.Thread/Timer construction "
+            "sites but no tools/dttsan/ sources — the inventory-"
+            "coverage rule would silently self-disable")]
+    from tools.dttsan.inventory import discover_roots
+
+    roots, _bad = discover_roots(index)
+    covered = {(r.path, r.line) for r in roots}
+    out = []
+    counters: dict = {}
+    for rel, qual, line, name in sorted(raw_sites):
+        if (rel, line) in covered:
+            continue
+        c = counters[rel] = counters.get(rel, _Counter())
+        out.append(Finding(
+            "DTT010", c.key(f"{rel}::{qual or '<module>'}:{name}"),
+            rel, line,
+            f"threading.{name} constructed here is NOT discoverable by "
+            f"the dttsan thread inventory (its target does not resolve "
+            f"to a named function/method) — an unnameable root escapes "
+            f"the registry and every concurrency pass; name the target "
+            f"(a def or self-method)"))
+    return out
+
+
+rule_inventory_coverage.rule_id = "DTT010"
+
+
 ALL_RULES = (
     rule_collective_axis,
     rule_ledger_coverage,
@@ -912,4 +972,5 @@ ALL_RULES = (
     rule_trace_purity,
     rule_donation_safety,
     rule_traced_coverage,
+    rule_inventory_coverage,
 )

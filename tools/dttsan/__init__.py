@@ -1,0 +1,193 @@
+"""dttsan — the static concurrency analyzer: the host plane's threads,
+locks, condition variables, and rings, proven race-free without a chip.
+
+The reference delegates all host-side concurrency to
+``tf.train.Supervisor``'s managed coordinator threads
+(``MNISTDist.py:159``); this repo reproduces that machinery by hand —
+batcher worker/expiry threads, the checkpoint writer, the prefetch
+staging worker, the watchdog, the serving watcher and HTTP handlers,
+excepthook/atexit/signal crash contexts — and that hand-rolled plane
+became the largest hand-fixed bug class left unchecked: PRs 6-13
+shipped at least nine review-caught thread-safety fixes (the
+StreamingHistogram snapshot-vs-count race, the FlightRecorder
+watchdog-vs-excepthook dump race, the watchdog firing inside its cv,
+MetricsLogger dual-sink locking, ServeTraceCapture, per-route
+histogram instances, ...). dttlint (r16) proved the AST layer and
+dttcheck (r18) the jaxpr layer; dttsan closes the triangle at the
+thread layer, in the spirit of RacerD's compositional lock-set
+analysis.
+
+Four passes (tools/dttsan/inventory.py + passes.py):
+
+  SAN001 thread-inventory  every concurrent entry point (Thread/Timer
+                           sites, threaded-server handler classes,
+                           excepthook/atexit/signal hooks, os._exit
+                           crash contexts) discovered from the AST and
+                           held against the checked-in
+                           ``registry.json`` BOTH directions — orphan
+                           root or phantom entry = finding
+  SAN002 shared-state      per class, every ``self.*`` attribute
+                           reached from >= 2 thread roots with a write
+                           outside ``__init__`` must have all writes
+                           under one COMMON lock (lock-set
+                           intersection) and reads under it too;
+                           documented monotonic/ring reads are
+                           exemptible only via a baseline reason
+  SAN003 lock-order        the acquisition graph (across call edges)
+                           must be acyclic; no plain-Lock re-acquire
+                           on the same path (self-deadlock); cv
+                           discipline: wait only inside a while
+                           predicate loop and never while holding
+                           another lock, notify only while holding, no
+                           sleep/join/result under any lock
+  SAN004 lifecycle         daemon/join hygiene per thread/timer; a
+                           restartable start() must not reuse a set
+                           stop Event (the CheckpointWatcher class);
+                           rings append-BOUNDED (deque maxlen) and
+                           snapshot-consistent; crash hooks never block
+
+Run it: ``python -m tools.dttsan [--json] [--baseline PATH]
+[--threads]``. Exit 0 = no non-baselined findings and no stale
+suppressions (the tier-1 contract, shared with dttlint/dttcheck via
+``tools/_analysis_common``); the checked-in ``baseline.json``
+suppresses by STABLE key with a mandatory ``reason`` per entry, and a
+stale entry fails loudly — the baseline only shrinks. Full repo < 10 s,
+chip-free. ``python -m tools.analyze`` runs all three analyzers with
+one merged exit code.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from tools._analysis_common import (  # noqa: E402 — the shared runner
+    REPO_ROOT,
+    AnalysisResult,
+    Finding,
+    apply_baseline,
+    load_baseline as _load_baseline,
+)
+from tools.dttlint import LINT_TARGETS, RepoIndex  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+ALL_PASSES = ("SAN001", "SAN002", "SAN003", "SAN004")
+
+#: the walk set: dttlint's (the package, tools/, the entry points) —
+#: the host plane lives in the same tree the AST linter already walks
+SAN_TARGETS = LINT_TARGETS
+
+
+def load_baseline(path: str | None = None) -> list[dict]:
+    return _load_baseline(path, DEFAULT_BASELINE)
+
+
+def build(root: str = REPO_ROOT, targets=SAN_TARGETS,
+          registry_path: str | None = None):
+    """(index, roots, model, registry_entries) — the shared build the
+    runner, the ``--threads`` printer, and dttlint DTT010 all ride."""
+    from tools.dttsan import inventory, passes
+
+    index = RepoIndex(root, targets)
+    roots, bad = inventory.discover_roots(index)
+    entries = inventory.load_registry(registry_path)
+    model = passes.build_model(index, roots)
+    passes.seed_callbacks(model, entries)
+    # callbacks change reachability — recompute contexts over the
+    # seeded roots
+    passes._propagate(model)
+    return index, roots, model, entries, bad
+
+
+def run_san(root: str = REPO_ROOT, baseline_path: str | None = None,
+            targets=SAN_TARGETS,
+            registry_path: str | None = None) -> AnalysisResult:
+    """The one entry point (CLI, tier-1 test, bench consan_phase,
+    tools/analyze)."""
+    from tools.dttsan import inventory, passes
+
+    index, roots, model, entries, found = build(root, targets,
+                                                registry_path)
+    found = list(found) + list(index.errors)
+    found.extend(inventory.check_registry(roots, entries, index))
+    found.extend(passes.pass_shared_state(model))
+    found.extend(passes.pass_lock_order(model))
+    found.extend(passes.pass_lifecycle(model, index))
+    report = {
+        "threads_total": sum(1 for r in roots
+                             if r.kind in ("thread", "timer",
+                                           "handler")),
+        "roots_total": len(roots),
+        "locks_total": len(model.tok_kind),
+        "classes_total": len(model.classes),
+        "shared_attrs": _shared_attr_count(model),
+    }
+    return apply_baseline(found, load_baseline(baseline_path),
+                          rules=ALL_PASSES, report=report)
+
+
+def threads_table(root: str = REPO_ROOT) -> list[dict]:
+    """The thread-inventory rows ``tools/trace_ops.py --threads`` and
+    ``--threads`` here print: one row per concurrent root with its
+    entry point, file:line, the shared ``self.*`` attributes its class
+    touches, and the locks that guard them — the fleet's thread plane
+    at a glance, no chip."""
+    from tools.dttsan import passes as _p
+
+    _index, roots, model, _entries, _bad = build(root)
+    # per class: shared attrs and their common locks (the SAN002 view)
+    by_attr: dict = {}
+    for fi in model.funcs.values():
+        for a in fi.accesses:
+            if not a.in_init:
+                by_attr.setdefault((a.owner, a.attr), []).append(a)
+    shared: dict = {}
+    for (owner, attr), accs in by_attr.items():
+        roots_touching = set()
+        for a in accs:
+            roots_touching |= model.roots_of(a.fn)
+        if len(roots_touching) < 2:
+            continue
+        locks = [model.guaranteed_entry(a.fn) | a.held for a in accs
+                 if a.kind == "write"]
+        common = (frozenset.intersection(*locks) if locks
+                  else frozenset())
+        shared.setdefault(owner, []).append(
+            (attr, sorted(_p._tok_str(t) for t in common)))
+    rows = []
+    for r in sorted(roots, key=lambda r: r.key):
+        owner = None
+        if r.target.startswith("self.") and r.scope:
+            owner = f"{r.path}::{r.scope.split('.', 1)[0]}"
+        elif r.kind == "handler":
+            owner = f"{r.path}::{r.target}"
+        attrs = sorted(shared.get(owner, [])) if owner else []
+        rows.append({
+            "kind": r.kind,
+            "site": f"{r.path}:{r.line}",
+            "scope": r.scope or "<module>",
+            "target": r.target,
+            "name": r.name,
+            "shared_attrs": [a for a, _l in attrs],
+            "locks": sorted({lk for _a, ls in attrs for lk in ls}),
+        })
+    return rows
+
+
+def _shared_attr_count(model) -> int:
+    seen = set()
+    for fi in model.funcs.values():
+        for a in fi.accesses:
+            if a.in_init:
+                continue
+            seen.add((a.owner, a.attr, a.fn))
+    attrs: dict = {}
+    for owner, attr, fn in seen:
+        attrs.setdefault((owner, attr), set()).update(
+            model.roots_of(fn))
+    return sum(1 for roots in attrs.values() if len(roots) >= 2)

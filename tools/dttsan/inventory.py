@@ -1,0 +1,329 @@
+"""dttsan pass 1 — the thread inventory: discover every concurrent
+entry point in the walk set and hold it against the checked-in registry.
+
+The reference delegated all host-side concurrency to
+``tf.train.Supervisor``'s managed coordinator threads; this repo
+reproduces that machinery by hand, thread by thread. The inventory makes
+that hand-rolled thread plane ENUMERABLE: every way code in this tree
+starts running concurrently is discovered by walking the AST —
+
+- ``threading.Thread(target=...)`` and ``threading.Timer(...)``
+  construction sites (the batcher worker/expiry pair, the checkpoint
+  writer, the prefetch staging worker, the watchdog, the serving
+  watcher/HTTP thread, the loadgen/bench traffic threads),
+- threaded-server HANDLER classes (``BaseHTTPRequestHandler`` /
+  ``socketserver.BaseRequestHandler`` subclasses — every ``do_GET`` /
+  ``handle`` runs on a per-connection thread),
+- asynchronous host contexts: ``sys.excepthook`` assignments,
+  ``atexit.register``, ``signal.signal`` handlers (main-thread but
+  interleaving at arbitrary points), and ``os._exit`` crash contexts
+  (the faults-crash path — the one place a postmortem must already be
+  on disk),
+
+— and recorded in ``tools/dttsan/registry.json`` the way
+``INJECTION_POINTS`` anchors DTT004: the registry is the reviewed,
+checked-in statement of "these are all the places this repo goes
+concurrent", and SAN001 fails BOTH directions — a discovered root
+missing from the registry (orphan: somebody added a thread nobody
+reviewed for lock discipline) and a registry entry with no discovered
+site (phantom: the thread died but its registration didn't).
+
+``callback`` registry entries are the one human-declared edge kind: a
+closure handed to another component as a callable (a batcher ``runner``,
+an ``on_batch`` hook) RUNS on that component's thread, which no local
+AST walk can see. The entry binds the closure's qualname to the root
+key it executes under; the shared-state pass seeds reachability from
+it, and SAN001 verifies the binding still names a real function and a
+real root (the phantom rule covers callbacks too).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass
+
+from tools._analysis_common import Finding
+from tools.dttlint.rules import _callee, _dotted
+
+
+def _walk_scoped(tree):
+    """Yield (node, qualname) with the enclosing scope qualname —
+    unlike dttlint's walker, CLASS names are part of the qual
+    ("CheckpointWatcher.start", not "start"), because root keys and
+    target resolution both need the owning class."""
+    def visit(node, qual):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                yield child, qual
+                yield from visit(child, f"{qual}.{child.name}"
+                                 if qual else child.name)
+            else:
+                yield child, qual
+                yield from visit(child, qual)
+
+    yield from visit(tree, "")
+
+#: discoverable root kinds (``callback`` is registry-declared, never
+#: discovered — it has no construction-site syntax of its own)
+ROOT_KINDS = ("thread", "timer", "handler", "excepthook", "atexit",
+              "signal", "crash")
+
+#: handler base classes whose methods run on per-connection threads
+_HANDLER_BASES = {"BaseHTTPRequestHandler", "BaseRequestHandler",
+                  "StreamRequestHandler", "DatagramRequestHandler"}
+
+DEFAULT_REGISTRY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "registry.json")
+
+
+@dataclass
+class ConcurrentRoot:
+    """One discovered concurrent entry point. ``key`` is the STABLE
+    identity (kind + file + enclosing scope + target symbol, never a
+    line number) the registry pins."""
+
+    kind: str
+    path: str
+    line: int
+    scope: str    # enclosing function qualname ("" = module level)
+    target: str   # the symbol that runs concurrently
+    name: str = ""  # thread name= literal, when present
+
+    @property
+    def key(self) -> str:
+        return f"{self.kind}:{self.path}:{self.scope or '<module>'}:" \
+               f"{self.target}"
+
+
+def _str_kw(call: ast.Call, kw: str) -> str:
+    for k in call.keywords:
+        if k.arg == kw and isinstance(k.value, ast.Constant) \
+                and isinstance(k.value.value, str):
+            return k.value.value
+    return ""
+
+
+def _kw(call: ast.Call, kw: str):
+    for k in call.keywords:
+        if k.arg == kw:
+            return k.value
+    return None
+
+
+def resolve_target(node, local_defs: set) -> str | None:
+    """A Thread/Timer/hook target expression -> its stable symbol:
+    ``self._loop`` / ``self.httpd.serve_forever`` (attribute chains),
+    ``_worker`` (a function DEFINED in an enclosing scope), or
+    ``<lambda>``. None = not statically resolvable (an arbitrary
+    callable value) — dttlint DTT010 makes that a finding, because a
+    root the inventory cannot name is a root no pass can prove."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Lambda):
+        return "<lambda>"
+    if isinstance(node, ast.Attribute):
+        return _dotted(node)  # self._loop, p.kill, self.httpd.serve_forever
+    if isinstance(node, ast.Name) and node.id in local_defs:
+        return node.id
+    return None
+
+
+def _local_def_names(tree) -> set:
+    """Every function name DEFINED anywhere in the module (any nesting
+    level) — the resolution set for bare-name targets."""
+    return {n.name for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def discover_roots(index) -> tuple[list[ConcurrentRoot], list[Finding]]:
+    """Walk the index and return (roots, unresolvable-site findings).
+    The findings here are SAN001's "a concurrency construct the
+    inventory cannot name" class; registry drift is judged separately
+    by ``check_registry``."""
+    roots: list[ConcurrentRoot] = []
+    bad: list[Finding] = []
+    for rel, tree in index.trees.items():
+        defs = _local_def_names(tree)
+        # handler classes: every method is a per-connection-thread root
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                bases = {(_dotted(b) or "").rsplit(".", 1)[-1]
+                         for b in node.bases}
+                if bases & _HANDLER_BASES:
+                    roots.append(ConcurrentRoot(
+                        "handler", rel, node.lineno, "", node.name))
+        for node, qual in _walk_scoped(tree):
+            if isinstance(node, ast.Assign):
+                # sys.excepthook = _hook
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            _dotted(t) == "sys.excepthook":
+                        target = resolve_target(node.value, defs)
+                        if target is None:
+                            bad.append(Finding(
+                                "SAN001",
+                                f"SAN001:{rel}:{qual or '<module>'}:"
+                                f"excepthook-unresolvable",
+                                rel, node.lineno,
+                                "sys.excepthook assigned a value the "
+                                "inventory cannot resolve to a function "
+                                "— name the hook (a def or self-method) "
+                                "so its lock discipline is provable"))
+                        elif not _is_restore(node.value, qual):
+                            roots.append(ConcurrentRoot(
+                                "excepthook", rel, node.lineno, qual,
+                                target))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func) or ""
+            callee = _callee(node)
+            head = chain.rsplit(".", 1)[0] if "." in chain else ""
+            if callee in ("Thread", "Timer") and head in ("", "threading"):
+                kind = "thread" if callee == "Thread" else "timer"
+                tnode = (_kw(node, "target") if kind == "thread" else
+                         (_kw(node, "function")
+                          or (node.args[1] if len(node.args) > 1
+                              else None)))
+                target = resolve_target(tnode, defs)
+                if target is None:
+                    bad.append(Finding(
+                        "SAN001",
+                        f"SAN001:{rel}:{qual or '<module>'}:"
+                        f"{kind}-unresolvable",
+                        rel, node.lineno,
+                        f"threading.{callee} constructed with a target "
+                        f"the inventory cannot resolve to a named "
+                        f"function — an unnameable root is a root no "
+                        f"pass can prove race-free"))
+                else:
+                    roots.append(ConcurrentRoot(
+                        kind, rel, node.lineno, qual, target,
+                        name=_str_kw(node, "name")))
+            elif chain == "atexit.register" and node.args:
+                target = resolve_target(node.args[0], defs)
+                if target is not None:
+                    roots.append(ConcurrentRoot(
+                        "atexit", rel, node.lineno, qual, target))
+            elif chain == "signal.signal" and len(node.args) > 1:
+                # only a handler that IS a visible function registers; a
+                # Name that matches no def is a saved-disposition
+                # RESTORE (signal.signal(sig, old)), not a new root
+                target = resolve_target(node.args[1], defs)
+                if target is not None:
+                    roots.append(ConcurrentRoot(
+                        "signal", rel, node.lineno, qual, target))
+            elif chain == "os._exit":
+                roots.append(ConcurrentRoot(
+                    "crash", rel, node.lineno, qual, qual or "<module>"))
+    # one root per key: N os._exit sites in one function are one crash
+    # context; re-registering per call would churn the registry
+    seen: dict[str, ConcurrentRoot] = {}
+    for r in roots:
+        seen.setdefault(r.key, r)
+    return list(seen.values()), bad
+
+
+def load_registry(path: str | None = None) -> list[dict]:
+    """The checked-in inventory. Every entry carries ``key`` and a
+    ``note`` (what this root is FOR — the reviewed statement); callback
+    entries also carry ``root`` (the thread-root key they execute
+    under)."""
+    path = path or DEFAULT_REGISTRY
+    if not os.path.exists(path):
+        return []
+    data = json.load(open(path, encoding="utf-8"))
+    entries = data.get("entries", [])
+    for e in entries:
+        if not {"key", "note"} <= set(e):
+            raise ValueError(
+                f"registry entry {e!r} must carry key and note (the "
+                f"note IS the reviewed statement of what this root is "
+                f"for)")
+        if e["key"].startswith("callback:") and "root" not in e:
+            raise ValueError(
+                f"callback entry {e['key']!r} must carry root (the "
+                f"thread-root key the callable executes under)")
+    return entries
+
+
+def check_registry(roots: list[ConcurrentRoot], entries: list[dict],
+                   index) -> list[Finding]:
+    """Both-direction drift: discovered-but-unregistered = orphan
+    (an unreviewed thread), registered-but-undiscovered = phantom (a
+    dead registration). Callback entries are verified against the
+    function table and the thread-root keys instead."""
+    out: list[Finding] = []
+    discovered = {r.key: r for r in roots}
+    registered = {e["key"] for e in entries}
+    for key, r in sorted(discovered.items()):
+        if key not in registered:
+            out.append(Finding(
+                "SAN001", key, r.path, r.line,
+                f"unregistered concurrent root {key!r} — every thread/"
+                f"timer/handler/hook root must be reviewed into "
+                f"tools/dttsan/registry.json (kind={r.kind}, "
+                f"target={r.target})"))
+    func_names = _all_qualnames(index)
+    for e in entries:
+        key = e["key"]
+        if key.startswith("callback:"):
+            # callback:<rel>:<qualname> — the function must exist and
+            # the bound root must itself be discovered
+            parts = key.split(":", 2)
+            qn = parts[2] if len(parts) == 3 else ""
+            rel = parts[1] if len(parts) == 3 else ""
+            if (rel, qn) not in func_names:
+                out.append(Finding(
+                    "SAN001", key, rel or "tools/dttsan", 0,
+                    f"phantom callback entry {key!r}: no function "
+                    f"{qn!r} in {rel!r} — delete or re-point the "
+                    f"entry"))
+            elif e["root"] not in discovered:
+                out.append(Finding(
+                    "SAN001", key, rel, 0,
+                    f"callback entry {key!r} binds to root "
+                    f"{e['root']!r} which the inventory no longer "
+                    f"discovers — re-point it at a live root"))
+        elif key not in discovered:
+            out.append(Finding(
+                "SAN001", key, key.split(":")[1] if ":" in key else "?",
+                0,
+                f"phantom registry entry {key!r}: the inventory no "
+                f"longer discovers this root — delete the entry (the "
+                f"registry tracks live concurrency, not history)"))
+    return out
+
+
+def _all_qualnames(index) -> set:
+    """{(rel, qualname)} for every function at every nesting level —
+    the existence check behind callback entries."""
+    out = set()
+    for rel, tree in index.trees.items():
+        def visit(node, qual, rel=rel):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    out.add((rel, q))
+                    visit(child, q)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{qual}.{child.name}"
+                          if qual else child.name)
+                else:
+                    visit(child, qual)
+
+        visit(tree, "")
+    return out
+
+
+def _is_restore(value, qual: str) -> bool:
+    """``sys.excepthook = prev_hook`` inside an installer is a chain
+    RESTORE, not a new hook — heuristically: the assigned name was
+    previously read FROM sys.excepthook in the same scope. We keep it
+    simple: a bare Name whose id contains 'prev' or 'old'."""
+    return isinstance(value, ast.Name) and \
+        any(s in value.id.lower() for s in ("prev", "old"))

@@ -1,0 +1,1214 @@
+"""dttsan passes 2-4 — the concurrency model and the proofs over it.
+
+The model is a TYPED, lock-annotated call graph built from the AST
+(RacerD's compositional shape, scaled to this repo): every function and
+method is scanned once, statement-ordered, carrying the set of lock
+tokens held at each point (``with self._lock:`` scopes, ``with
+self.stats.lock:`` cross-object scopes, manual ``cv.acquire()`` /
+``release()`` discipline, module-level locks); every ``self.*``
+attribute access is resolved to its OWNING class and recorded with the
+locks held around it. Types come only from places the tree states them
+(constructor assignments, parameter/return/local annotations,
+module-level singletons) — never guessed, so a resolution miss degrades
+to silence, not a false finding.
+
+Reachability seeds from the inventory's roots (plus the ``main``
+pseudo-root: everything the public API can run on the caller's thread)
+and a fixpoint propagates HELD-AT-ENTRY contexts through call edges, so
+a helper like the batcher's ``_expire_locked`` — which never takes the
+cv itself but is only ever called with it held — is judged with the cv
+in hand.
+
+The passes:
+
+- **SAN002 shared-state** — a ``self.*`` attribute reached from >= 2
+  roots with a write outside ``__init__`` must have every write inside
+  a scope holding one COMMON lock (lock-set intersection over all
+  writes), and reads must hold it too. Unguarded reads of documented
+  monotonic/ring fields are exemptible only via a baseline reason —
+  the StreamingHistogram snapshot-vs-count and MetricsLogger dual-sink
+  classes (PR 6's hand fixes), machine-checked.
+- **SAN003 lock-order** — the acquisition graph (edge A->B when B is
+  taken while A is held, across call edges) must be acyclic (the
+  static dual of the r11 watchdog's deadlock classes); a plain Lock
+  must never be re-acquired while already held on the same path
+  (self-deadlock — the excepthook/atexit reentrancy class);
+  condition-variable discipline: ``wait`` only inside a ``while``
+  predicate loop, ``notify`` only while holding, no ``wait``/``sleep``
+  /``join``/``result`` while holding any OTHER lock a serve/display
+  path also takes.
+- **SAN004 lifecycle** — daemon/join hygiene for every inventory
+  thread/timer; restartable start methods must not reuse a set stop
+  Event (the CheckpointWatcher class of bug); rings (the telemetry
+  span ring, flight ring, reqtrace audit ring) must be append-BOUNDED
+  (``deque(maxlen=...)``) and snapshot-CONSISTENT (iteration only
+  under the ring's common lock); excepthook/atexit/signal handlers
+  must not block.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools._analysis_common import Finding
+from tools.dttlint.rules import _dotted
+
+LOCK_TYPES = {"Lock", "RLock"}
+COND_TYPES = {"Condition"}
+EVENT_TYPES = {"Event"}
+#: method calls on attrs of these types are synchronization, not state
+SAFE_TYPES = (LOCK_TYPES | COND_TYPES | EVENT_TYPES
+              | {"Semaphore", "BoundedSemaphore", "Barrier", "local",
+                 "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"})
+
+#: mutating container/object methods — a call through an attr counts as
+#: a WRITE to that attr (list/dict/deque/set surface)
+MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+            "pop", "popleft", "popitem", "remove", "discard", "clear",
+            "add", "update", "setdefault", "sort", "reverse", "put",
+            "put_nowait", "rotate"}
+
+MAX_CONTEXTS = 16  # held-at-entry variants kept per function
+
+
+# --------------------------------------------------------------- model
+
+
+@dataclass
+class Access:
+    owner: str          # "{rel}::{Class}" the attribute belongs to
+    attr: str
+    kind: str           # "read" | "write" | "iter"
+    held: frozenset     # lock tokens held locally around the access
+    fn: str             # funcid of the accessing function
+    line: int
+    in_init: bool       # inside the owner's own __init__
+
+
+@dataclass
+class FuncInfo:
+    fnid: str
+    rel: str
+    qual: str
+    line: int
+    accesses: list = field(default_factory=list)
+    calls: list = field(default_factory=list)      # (callee, held, line)
+    acquires: list = field(default_factory=list)   # (held_before, tok, line)
+    waits: list = field(default_factory=list)      # (tok, line, in_while, held)
+    notifies: list = field(default_factory=list)   # (tok, line, held)
+    blocking: list = field(default_factory=list)   # (desc, held, line)
+
+
+@dataclass
+class ClassInfo:
+    rel: str
+    name: str
+    line: int
+    methods: dict = field(default_factory=dict)      # name -> fnid
+    attr_types: dict = field(default_factory=dict)   # attr -> ctor name
+    attr_classes: dict = field(default_factory=dict)  # attr -> classkey
+    ring_bounded: dict = field(default_factory=dict)  # deque attr -> bool
+    ring_lines: dict = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.rel}::{self.name}"
+
+
+@dataclass
+class SanModel:
+    classes: dict = field(default_factory=dict)   # classkey -> ClassInfo
+    funcs: dict = field(default_factory=dict)     # fnid -> FuncInfo
+    tok_kind: dict = field(default_factory=dict)  # token -> Lock/RLock/Condition/expr
+    roots: list = field(default_factory=list)     # inventory roots
+    root_funcs: dict = field(default_factory=dict)  # root key -> set(fnid)
+    reach: dict = field(default_factory=dict)     # root key -> set(fnid)
+    main_reach: set = field(default_factory=set)  # fnids on caller threads
+    contexts: dict = field(default_factory=dict)  # fnid -> set(frozenset)
+
+    def guaranteed_entry(self, fnid: str) -> frozenset:
+        ctxs = self.contexts.get(fnid)
+        if not ctxs:
+            return frozenset()
+        it = iter(ctxs)
+        out = set(next(it))
+        for c in it:
+            out &= c
+        return frozenset(out)
+
+    def roots_of(self, fnid: str) -> set:
+        out = {key for key, fns in self.reach.items() if fnid in fns}
+        if fnid in self.main_reach:
+            out.add("main")
+        return out
+
+
+def _module_rel(index, dotted: str) -> str | None:
+    """'distributed_tensorflow_tpu.utils.telemetry' -> its index rel
+    path (module file or package __init__), when in the walk set."""
+    base = dotted.replace(".", "/")
+    for cand in (f"{base}.py", f"{base}/__init__.py"):
+        if cand in index.trees:
+            return cand
+    return None
+
+
+class _ModuleTable:
+    """Per-module symbol resolution: local classes/functions, imported
+    names, module-level singletons and locks."""
+
+    def __init__(self, index, rel: str, tree):
+        self.rel = rel
+        self.classes: dict[str, str] = {}    # local name -> classkey
+        self.functions: set[str] = set()
+        self.modules: dict[str, str] = {}    # alias -> rel
+        self.imported_fns: dict[str, tuple] = {}   # name -> (rel, fname)
+        self.singletons: dict[str, str] = {}  # NAME -> classkey
+        self.locks: set[str] = set()          # module-level lock names
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = f"{rel}::{node.name}"
+            elif isinstance(node, ast.FunctionDef):
+                self.functions.add(node.name)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    target = _module_rel(index, alias.name)
+                    if target:
+                        self.modules[alias.asname or alias.name] = target
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    sub = _module_rel(index,
+                                      f"{node.module}.{alias.name}")
+                    if sub:
+                        self.modules[bound] = sub
+                        continue
+                    src = _module_rel(index, node.module)
+                    if src is None:
+                        continue
+                    src_tree = index.trees[src]
+                    for n in src_tree.body:
+                        if isinstance(n, ast.ClassDef) and \
+                                n.name == alias.name:
+                            self.classes[bound] = f"{src}::{alias.name}"
+                            break
+                        if isinstance(n, ast.FunctionDef) and \
+                                n.name == alias.name:
+                            self.imported_fns[bound] = (src, alias.name)
+                            break
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                name = node.targets[0].id
+                ctor = _ctor_name(node.value)
+                if ctor in LOCK_TYPES | COND_TYPES:
+                    self.locks.add(name)
+                elif ctor in self.classes:
+                    self.singletons[name] = self.classes[ctor]
+
+
+def _ctor_name(call: ast.Call) -> str:
+    chain = _dotted(call.func) or ""
+    return chain.rsplit(".", 1)[-1]
+
+
+def _annotation_class(ann, table: _ModuleTable) -> str | None:
+    """Resolve a parameter/return annotation to a repo classkey. Handles
+    ``T``, ``"T"``, ``T | None``, ``Optional[T]``."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.BinOp):  # T | None
+        return (_annotation_class(ann.left, table)
+                or _annotation_class(ann.right, table))
+    if isinstance(ann, ast.Subscript):  # Optional[T]
+        return _annotation_class(ann.slice, table)
+    name = _dotted(ann) if isinstance(ann, (ast.Name, ast.Attribute)) \
+        else None
+    if name:
+        return table.classes.get(name.rsplit(".", 1)[-1])
+    return None
+
+
+# ------------------------------------------------------- class scanning
+
+
+def _scan_class_shape(rel: str, node: ast.ClassDef,
+                      table: _ModuleTable) -> ClassInfo:
+    ci = ClassInfo(rel, node.name, node.lineno)
+    for item in node.body:
+        if isinstance(item, ast.FunctionDef):
+            ci.methods[item.name] = f"{rel}::{node.name}.{item.name}"
+        elif isinstance(item, ast.AnnAssign) and \
+                isinstance(item.target, ast.Name):
+            # dataclass fields: type from the annotation, or the
+            # field(default_factory=...) constructor
+            attr = item.target.id
+            t = None
+            if isinstance(item.value, ast.Call) and \
+                    _ctor_name(item.value) == "field":
+                for k in item.value.keywords:
+                    if k.arg == "default_factory":
+                        t = (_dotted(k.value) or "").rsplit(".", 1)[-1]
+            if t is None and item.annotation is not None:
+                t = (_dotted(item.annotation) or "").rsplit(".", 1)[-1]
+            if t:
+                ci.attr_types[attr] = t
+    init = next((i for i in node.body if isinstance(i, ast.FunctionDef)
+                 and i.name == "__init__"), None)
+    if init is not None:
+        # parameter annotations type the attrs they're stored into
+        param_cls = {}
+        args = init.args
+        for a in list(args.args) + list(args.kwonlyargs):
+            ck = _annotation_class(a.annotation, table)
+            if ck:
+                param_cls[a.arg] = ck
+        for sub in ast.walk(init):
+            tgt = val = None
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                tgt, val = sub.targets[0], sub.value
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                tgt, val = sub.target, sub.value
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            attr = tgt.attr
+            if isinstance(val, ast.Call):
+                ctor = _ctor_name(val)
+                ci.attr_types.setdefault(attr, ctor)
+                if ctor in table.classes:
+                    ci.attr_classes[attr] = table.classes[ctor]
+                if ctor == "deque":
+                    ci.ring_bounded[attr] = any(
+                        k.arg == "maxlen" for k in val.keywords)
+                    ci.ring_lines[attr] = sub.lineno
+            elif isinstance(val, ast.Name) and val.id in param_cls:
+                ci.attr_classes[attr] = param_cls[val.id]
+    return ci
+
+
+# ----------------------------------------------------- function scanner
+
+
+class _FnScanner:
+    """One statement-ordered walk of a function body, tracking held
+    locks (with-scopes + manual acquire/release), local types and lock
+    aliases, and recording accesses / call edges / CV discipline."""
+
+    def __init__(self, model: SanModel, table: _ModuleTable, rel: str,
+                 qual: str, cls: ClassInfo | None, node,
+                 types: dict | None = None):
+        self.model = model
+        self.table = table
+        self.rel = rel
+        self.cls = cls
+        self.qual = qual
+        self.fnid = f"{rel}::{qual}"
+        self.info = FuncInfo(self.fnid, rel, qual, node.lineno)
+        self.node = node
+        self.types: dict[str, str] = dict(types or {})  # name -> classkey
+        self.lock_alias: dict[str, tuple] = {}          # name -> token
+        self.held: list[tuple] = []
+        self.while_depth = 0
+        self.in_init = (cls is not None
+                        and qual == f"{cls.name}.__init__")
+        args = node.args
+        for a in list(args.args) + list(args.kwonlyargs):
+            ck = _annotation_class(a.annotation, table)
+            if ck:
+                self.types[a.arg] = ck
+
+    # -- resolution helpers
+
+    def _class_of(self, expr) -> str | None:
+        """classkey of an expression's value, or None."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and self.cls is not None:
+                return self.cls.key
+            if expr.id in self.types:
+                return self.types[expr.id]
+            if expr.id in self.table.singletons:
+                return self.table.singletons[expr.id]
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = self._class_of(expr.value)
+            if base and base in self.model.classes:
+                return self.model.classes[base].attr_classes.get(
+                    expr.attr)
+            return None
+        if isinstance(expr, ast.Call):
+            chain = _dotted(expr.func) or ""
+            name = chain.rsplit(".", 1)[-1]
+            if name in self.table.classes:
+                return self.table.classes[name]
+            # typed factory: fn() -> T (return annotation)
+            fnid = self._callee_fnid(expr)
+            if fnid:
+                ret = _RETURNS.get(fnid)
+                if ret:
+                    return ret
+            return None
+        return None
+
+    def _lock_token(self, expr) -> tuple | None:
+        """Resolve a with-item / receiver to a lock token, else None."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.lock_alias:
+                return self.lock_alias[expr.id]
+            if expr.id in self.table.locks:
+                return (f"{self.rel}::<module>", expr.id)
+            return None
+        if isinstance(expr, ast.Attribute):
+            base_cls = self._class_of(expr.value)
+            if base_cls and base_cls in self.model.classes:
+                ci = self.model.classes[base_cls]
+                t = ci.attr_types.get(expr.attr)
+                if t in LOCK_TYPES | COND_TYPES:
+                    tok = (base_cls, expr.attr)
+                    self.model.tok_kind.setdefault(tok, t)
+                    return tok
+            return None
+        if isinstance(expr, ast.Call):
+            # a lock-returning helper (per-key lock maps): token by
+            # call text, so identical sites share a guard identity
+            name = (_dotted(expr.func) or "").rsplit(".", 1)[-1]
+            if "lock" in name.lower():
+                tok = (f"{self.rel}::{self.qual}", ast.unparse(expr))
+                self.model.tok_kind.setdefault(tok, "Lock")
+                return tok
+        return None
+
+    def _attr_kind(self, expr) -> str | None:
+        """ctor type of an attribute expr (self.X / obj.X), or None."""
+        if not isinstance(expr, ast.Attribute):
+            return None
+        base = self._class_of(expr.value)
+        if base and base in self.model.classes:
+            return self.model.classes[base].attr_types.get(expr.attr)
+        return None
+
+    def _callee_fnid(self, call: ast.Call) -> str | None:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in self.table.imported_fns:
+                src, fname = self.table.imported_fns[f.id]
+                return f"{src}::{fname}"
+            if f.id in self.table.functions:
+                return f"{self.rel}::{f.id}"
+            if f.id in self.table.classes:
+                ck = self.table.classes[f.id]
+                ci = self.model.classes.get(ck)
+                if ci and "__init__" in ci.methods:
+                    return ci.methods["__init__"]
+            # a closure defined in an enclosing scope of this function
+            for scope in _enclosing_quals(self.qual):
+                cand = f"{self.rel}::{scope}.{f.id}" if scope \
+                    else f"{self.rel}::{f.id}"
+                if cand in _KNOWN_FNIDS:
+                    return cand
+            return None
+        if isinstance(f, ast.Attribute):
+            recv_cls = self._class_of(f.value)
+            if recv_cls and recv_cls in self.model.classes:
+                return self.model.classes[recv_cls].methods.get(f.attr)
+            if isinstance(f.value, ast.Name) and \
+                    f.value.id in self.table.modules:
+                mod = self.table.modules[f.value.id]
+                return f"{mod}::{f.attr}"
+        return None
+
+    # -- access recording
+
+    def _record_attr(self, expr: ast.Attribute, kind: str,
+                     line: int) -> None:
+        base_cls = self._class_of(expr.value)
+        if not base_cls:
+            return
+        ci = self.model.classes.get(base_cls)
+        if ci is None:
+            return
+        attr = expr.attr
+        t = ci.attr_types.get(attr)
+        if t in SAFE_TYPES and kind != "write":
+            return  # calls/reads of sync primitives are the guards
+        if attr in ci.methods:
+            # property / method read — a call edge, not a state access
+            self.info.calls.append((ci.methods[attr],
+                                    frozenset(self.held), line))
+            return
+        in_init = (self.in_init and self.cls is not None
+                   and base_cls == self.cls.key)
+        self.info.accesses.append(Access(
+            base_cls, attr, kind, frozenset(self.held), self.fnid,
+            line, in_init))
+
+    # -- the walk
+
+    def scan(self) -> FuncInfo:
+        self._stmts(self.node.body)
+        return self.info
+
+    def _stmts(self, stmts) -> None:
+        for s in stmts:
+            self._stmt(s)
+
+    def _stmt(self, s) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a closure: its body runs LATER on whoever calls it —
+            # scanned as its own function with a fresh held set but the
+            # enclosing type environment (captured params stay typed)
+            _scan_function(self.model, self.table, self.rel,
+                           f"{self.qual}.{s.name}", self.cls, s,
+                           dict(self.types))
+            return
+        if isinstance(s, ast.With):
+            toks = []
+            for item in s.items:
+                tok = self._lock_token(item.context_expr)
+                if tok is not None:
+                    self.info.acquires.append(
+                        (frozenset(self.held), tok, s.lineno))
+                    self.held.append(tok)
+                    toks.append(tok)
+                else:
+                    self._expr(item.context_expr)
+            self._stmts(s.body)
+            for tok in toks:
+                self.held.remove(tok)
+            return
+        if isinstance(s, (ast.If,)):
+            self._expr(s.test)
+            self._stmts(s.body)
+            self._stmts(s.orelse)
+            return
+        if isinstance(s, ast.While):
+            self._expr(s.test)
+            self.while_depth += 1
+            self._stmts(s.body)
+            self.while_depth -= 1
+            self._stmts(s.orelse)
+            return
+        if isinstance(s, ast.For):
+            self._expr(s.target)
+            self._iter_expr(s.iter)
+            self.while_depth += 1
+            self._stmts(s.body)
+            self.while_depth -= 1
+            self._stmts(s.orelse)
+            return
+        if isinstance(s, ast.Try):
+            self._stmts(s.body)
+            for h in s.handlers:
+                self._stmts(h.body)
+            self._stmts(s.orelse)
+            self._stmts(s.finalbody)
+            return
+        if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._assign(s)
+            return
+        if isinstance(s, ast.Delete):
+            for t in s.targets:
+                if isinstance(t, ast.Attribute):
+                    self._record_attr(t, "write", s.lineno)
+                elif isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Attribute):
+                    self._record_attr(t.value, "write", s.lineno)
+            return
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    def _assign(self, s) -> None:
+        value = s.value
+        if value is not None:
+            self._expr(value)
+        targets = (s.targets if isinstance(s, ast.Assign)
+                   else [s.target])
+        for t in targets:
+            if isinstance(t, ast.Attribute):
+                self._record_attr(t, "write", s.lineno)
+                if isinstance(s, ast.AugAssign):
+                    self._record_attr(t, "read", s.lineno)
+            elif isinstance(t, ast.Subscript):
+                self._expr(t.slice)
+                if isinstance(t.value, ast.Attribute):
+                    self._record_attr(t.value, "write", s.lineno)
+                elif isinstance(t.value, ast.Name):
+                    pass  # local container
+            elif isinstance(t, ast.Name) and value is not None:
+                # local typing: alias to a lock, or a typed value
+                tok = self._lock_token(value)
+                if tok is not None:
+                    self.lock_alias[t.id] = tok
+                else:
+                    ck = self._class_of(value)
+                    if ck:
+                        self.types[t.id] = ck
+                ann = getattr(s, "annotation", None)
+                ck = _annotation_class(ann, self.table)
+                if ck:
+                    self.types[t.id] = ck
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for el in t.elts:
+                    if isinstance(el, ast.Attribute):
+                        self._record_attr(el, "write", s.lineno)
+
+    def _iter_expr(self, expr) -> None:
+        """A for-loop iterable: iterating an attribute IS a read that
+        must be snapshot-consistent (kind 'iter')."""
+        if isinstance(expr, ast.Attribute):
+            self._record_attr(expr, "iter", expr.lineno)
+        else:
+            self._expr(expr)
+
+    def _expr(self, e) -> None:
+        if e is None:
+            return
+        if isinstance(e, ast.Call):
+            self._call(e)
+            return
+        if isinstance(e, ast.Attribute):
+            self._record_attr(e, "read", e.lineno)
+            if not isinstance(e.value, ast.Name):
+                self._expr(e.value)
+            return
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp)):
+            for gen in e.generators:
+                self._iter_expr(gen.iter)
+                for cond in gen.ifs:
+                    self._expr(cond)
+            for sub in ast.iter_child_nodes(e):
+                if isinstance(sub, ast.expr) and sub not in [
+                        g.iter for g in e.generators]:
+                    self._expr(sub)
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _call(self, call: ast.Call) -> None:
+        chain = _dotted(call.func) or ""
+        method = chain.rsplit(".", 1)[-1]
+        handled_recv = False
+        if isinstance(call.func, ast.Attribute):
+            recv = call.func.value
+            tok = self._lock_token(recv)
+            kind = self.model.tok_kind.get(tok) if tok else None
+            if tok is not None:
+                handled_recv = True
+                if method == "acquire":
+                    self.info.acquires.append(
+                        (frozenset(self.held), tok, call.lineno))
+                    self.held.append(tok)
+                elif method == "release":
+                    if tok in self.held:
+                        self.held.remove(tok)
+                elif method == "wait" and kind in COND_TYPES:
+                    self.info.waits.append(
+                        (tok, call.lineno, self.while_depth > 0,
+                         frozenset(self.held)))
+                elif method in ("notify", "notify_all"):
+                    self.info.notifies.append(
+                        (tok, call.lineno, frozenset(self.held)))
+            else:
+                akind = self._attr_kind(recv)
+                if akind in EVENT_TYPES and method == "wait" \
+                        and self.held:
+                    self.info.blocking.append(
+                        (f"{_dotted(recv)}.wait", frozenset(self.held),
+                         call.lineno))
+                recv_cls = self._class_of(recv)
+                if recv_cls and recv_cls in self.model.classes and \
+                        method in self.model.classes[recv_cls].methods:
+                    pass  # resolved call edge below
+                elif isinstance(recv, ast.Attribute):
+                    handled_recv = True
+                    if akind in SAFE_TYPES:
+                        pass  # sync-primitive op (put/get/set/clear)
+                    elif method in MUTATORS:
+                        self._record_attr(recv, "write", call.lineno)
+                    else:
+                        self._record_attr(recv, "read", call.lineno)
+        # blocking calls while holding a lock
+        if self.held:
+            if chain == "time.sleep":
+                self.info.blocking.append(
+                    ("time.sleep", frozenset(self.held), call.lineno))
+            elif method in ("join", "result") and \
+                    isinstance(call.func, ast.Attribute) and \
+                    not isinstance(call.func.value, ast.Constant) and \
+                    not chain.startswith(("os.path", "posixpath")):
+                self.info.blocking.append(
+                    (chain or method, frozenset(self.held), call.lineno))
+        callee = self._callee_fnid(call)
+        if callee is not None:
+            self.info.calls.append((callee, frozenset(self.held),
+                                    call.lineno))
+        if not handled_recv and isinstance(call.func, ast.Attribute):
+            self._expr(call.func.value)
+        for a in call.args:
+            self._expr(a)
+        for k in call.keywords:
+            self._expr(k.value)
+
+
+def _enclosing_quals(qual: str):
+    parts = qual.split(".")
+    for i in range(len(parts), -1, -1):
+        yield ".".join(parts[:i])
+
+
+# module-global scratch for one build (single-threaded, rebuilt per run)
+_KNOWN_FNIDS: set = set()
+_RETURNS: dict = {}
+
+
+def _scan_function(model: SanModel, table: _ModuleTable, rel: str,
+                   qual: str, cls: ClassInfo | None, node,
+                   types: dict | None = None) -> None:
+    sc = _FnScanner(model, table, rel, qual, cls, node, types)
+    model.funcs[sc.fnid] = sc.scan()
+
+
+# ------------------------------------------------------------ the build
+
+
+def build_model(index, roots) -> SanModel:
+    """Two passes over the walk set: shape (classes, attr types,
+    signatures) then bodies (accesses under held locks, call edges),
+    followed by reachability + held-at-entry fixpoints."""
+    model = SanModel(roots=list(roots))
+    tables = {rel: _ModuleTable(index, rel, tree)
+              for rel, tree in index.trees.items()}
+    # pass 1: shapes
+    for rel, tree in index.trees.items():
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                ci = _scan_class_shape(rel, node, tables[rel])
+                model.classes[ci.key] = ci
+    _KNOWN_FNIDS.clear()
+    _RETURNS.clear()
+    # known fnids + return annotations (for typed factories)
+    for rel, tree in index.trees.items():
+        def collect(node, qual, rel=rel):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    fnid = f"{rel}::{q}"
+                    _KNOWN_FNIDS.add(fnid)
+                    ck = _annotation_class(child.returns, tables[rel])
+                    if ck:
+                        _RETURNS[fnid] = ck
+                    collect(child, q)
+                elif isinstance(child, ast.ClassDef):
+                    collect(child, f"{qual}.{child.name}"
+                            if qual else child.name)
+                else:
+                    collect(child, qual)
+
+        collect(tree, "")
+    # pass 2: bodies (top-level functions and class methods; closures
+    # recurse from inside the scanner)
+    for rel, tree in index.trees.items():
+        table = tables[rel]
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                _scan_function(model, table, rel, node.name, None, node)
+            elif isinstance(node, ast.ClassDef):
+                ci = model.classes[f"{rel}::{node.name}"]
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        _scan_function(model, table, rel,
+                                       f"{node.name}.{item.name}", ci,
+                                       item)
+    _resolve_roots(model, index)
+    _propagate(model)
+    return model
+
+
+def _resolve_roots(model: SanModel, index) -> None:
+    """Map inventory roots to the function ids they execute."""
+    for r in model.roots:
+        fns: set = set()
+        if r.kind == "crash":
+            model.root_funcs[r.key] = fns
+            continue
+        if r.kind == "handler":
+            ck = f"{r.path}::{r.target}"
+            ci = model.classes.get(ck)
+            if ci:
+                fns |= set(ci.methods.values())
+        elif r.target.startswith("self."):
+            parts = r.target.split(".")
+            cls_name = r.scope.split(".", 1)[0] if r.scope else ""
+            ci = model.classes.get(f"{r.path}::{cls_name}")
+            if ci and len(parts) == 2 and parts[1] in ci.methods:
+                fns.add(ci.methods[parts[1]])
+            elif ci and len(parts) == 3:
+                inner = model.classes.get(
+                    ci.attr_classes.get(parts[1], ""))
+                if inner and parts[2] in inner.methods:
+                    fns.add(inner.methods[parts[2]])
+        else:
+            for scope in _enclosing_quals(r.scope):
+                cand = f"{r.path}::{scope}.{r.target}" if scope \
+                    else f"{r.path}::{r.target}"
+                if cand in model.funcs:
+                    fns.add(cand)
+                    break
+        model.root_funcs[r.key] = fns
+
+
+def seed_callbacks(model: SanModel, registry_entries) -> None:
+    """callback registry entries: the named closure runs under the
+    named thread root (the one edge kind the AST cannot see)."""
+    for e in registry_entries:
+        key = e.get("key", "")
+        if not key.startswith("callback:"):
+            continue
+        parts = key.split(":", 2)
+        if len(parts) != 3:
+            continue
+        fnid = f"{parts[1]}::{parts[2]}"
+        if fnid in model.funcs and e.get("root") in model.root_funcs:
+            model.root_funcs[e["root"]].add(fnid)
+
+
+def _propagate(model: SanModel) -> None:
+    """Reachability per root + the main pseudo-root, then the
+    held-at-entry context fixpoint along call edges."""
+    edges: dict[str, list] = {}
+    for fnid, fi in model.funcs.items():
+        edges[fnid] = [(c, h) for c, h, _l in fi.calls
+                       if c in model.funcs]
+
+    def closure(seed: set) -> set:
+        out = set(seed)
+        stack = list(seed)
+        while stack:
+            for callee, _h in edges.get(stack.pop(), ()):
+                if callee not in out:
+                    out.add(callee)
+                    stack.append(callee)
+        return out
+
+    root_targets: set = set()
+    for key, fns in model.root_funcs.items():
+        model.reach[key] = closure(fns)
+        root_targets |= fns
+    main_seed = set()
+    for fnid, fi in model.funcs.items():
+        leaf = fi.qual.rsplit(".", 1)[-1]
+        public = not leaf.startswith("_") or (
+            leaf.startswith("__") and leaf.endswith("__"))
+        if public and fnid not in root_targets:
+            main_seed.add(fnid)
+    model.main_reach = closure(main_seed)
+
+    # held-at-entry contexts
+    ctxs: dict[str, set] = {}
+    work = []
+    for key, fns in model.root_funcs.items():
+        for fnid in fns:
+            ctxs.setdefault(fnid, set()).add(frozenset())
+            work.append(fnid)
+    for fnid in main_seed:
+        ctxs.setdefault(fnid, set()).add(frozenset())
+        work.append(fnid)
+    seen_push = 0
+    while work and seen_push < 200000:
+        fnid = work.pop()
+        for callee, held in edges.get(fnid, ()):
+            target = ctxs.setdefault(callee, set())
+            changed = False
+            for c in list(ctxs.get(fnid, {frozenset()})):
+                ctx = c | held
+                if ctx not in target:
+                    if len(target) >= MAX_CONTEXTS:
+                        # collapse: keep the intersection (the
+                        # guaranteed part survives; variants drop)
+                        inter = frozenset.intersection(*target, ctx)
+                        target.clear()
+                        target.add(inter)
+                        changed = True
+                        break
+                    target.add(ctx)
+                    changed = True
+            if changed:
+                work.append(callee)
+                seen_push += 1
+    model.contexts = ctxs
+
+
+# --------------------------------------------------------------- SAN002
+
+
+def _tok_str(tok) -> str:
+    owner, name = tok
+    return f"{owner.split('::')[-1]}.{name}"
+
+
+def pass_shared_state(model: SanModel) -> list[Finding]:
+    """SAN002: lock-set intersection per shared attribute (see module
+    docstring). One finding per (class, attr, category) — the key is
+    symbol-stable, the line points at the first offending site."""
+    out: list[Finding] = []
+    by_attr: dict[tuple, list] = {}
+    for fi in model.funcs.values():
+        for a in fi.accesses:
+            by_attr.setdefault((a.owner, a.attr), []).append(a)
+    for (owner, attr), accs in sorted(by_attr.items()):
+        ci = model.classes.get(owner)
+        if ci is None:
+            continue
+        roots: set = set()
+        for a in accs:
+            if not a.in_init:
+                roots |= model.roots_of(a.fn)
+        if len(roots) < 2:
+            continue
+        writes = [a for a in accs if a.kind == "write" and not a.in_init
+                  and model.roots_of(a.fn)]
+        if not writes:
+            continue
+        guaranteed = {}
+        for a in accs:
+            guaranteed[id(a)] = model.guaranteed_entry(a.fn) | a.held
+        rel, cls = owner.split("::")
+        base = f"{rel}:{cls}.{attr}"
+        naked = [a for a in writes if not guaranteed[id(a)]]
+        if naked:
+            w = min(naked, key=lambda a: (a.fn, a.line))
+            out.append(Finding(
+                "SAN002", f"{base}:unguarded-write",
+                w.fn.split("::")[0], w.line,
+                f"{cls}.{attr} is written without any lock in "
+                f"{w.fn.split('::')[-1]}() but is reached from "
+                f"{len(roots)} concurrent roots "
+                f"({', '.join(sorted({_root_short(r) for r in roots}))}) "
+                f"— every mutating access needs one common lock"))
+            continue
+        common = frozenset.intersection(
+            *[guaranteed[id(a)] for a in writes])
+        if not common:
+            w = writes[0]
+            locksets = sorted({", ".join(sorted(map(_tok_str,
+                                                    guaranteed[id(a)])))
+                               for a in writes})
+            out.append(Finding(
+                "SAN002", f"{base}:mixed-locks",
+                w.fn.split("::")[0], w.line,
+                f"{cls}.{attr} is written under DIFFERENT locks "
+                f"({' | '.join(locksets)}) from {len(roots)} roots — "
+                f"the lock sets do not intersect, so two writers can "
+                f"hold their own lock simultaneously"))
+            continue
+        bad_reads = [a for a in accs
+                     if a.kind in ("read", "iter") and not a.in_init
+                     and model.roots_of(a.fn)
+                     and not (guaranteed[id(a)] & common)]
+        if bad_reads:
+            rd = min(bad_reads, key=lambda a: (a.fn, a.line))
+            out.append(Finding(
+                "SAN002", f"{base}:unguarded-read",
+                rd.fn.split("::")[0], rd.line,
+                f"{cls}.{attr} is read lock-free in "
+                f"{rd.fn.split('::')[-1]}() while writers hold "
+                f"{'/'.join(sorted(map(_tok_str, common)))} — a torn "
+                f"or stale read; take the lock, or baseline with the "
+                f"documented monotonic/ring reason"))
+    return out
+
+
+def _root_short(key: str) -> str:
+    if key == "main":
+        return "main"
+    parts = key.split(":")
+    return f"{parts[0]}:{parts[-1]}"
+
+
+# --------------------------------------------------------------- SAN003
+
+
+def pass_lock_order(model: SanModel) -> list[Finding]:
+    out: list[Finding] = []
+    # acquisition graph across call edges (entry contexts already fold
+    # callers' held sets in)
+    graph: dict[tuple, set] = {}
+    sites: dict[tuple, tuple] = {}
+    for fi in model.funcs.values():
+        entries = model.contexts.get(fi.fnid, {frozenset()})
+        for held_before, tok, line in fi.acquires:
+            for ctx in entries:
+                for h in ctx | held_before:
+                    if h != tok:
+                        graph.setdefault(h, set()).add(tok)
+                        sites.setdefault((h, tok), (fi.rel, fi.qual,
+                                                    line))
+                # plain-Lock re-acquire on the same path = self-deadlock
+                if tok in (ctx | held_before) and \
+                        model.tok_kind.get(tok) in LOCK_TYPES:
+                    key = f"double-acquire:{fi.rel}:{fi.qual}:" \
+                          f"{_tok_str(tok)}"
+                    if not any(f.key == key for f in out):
+                        out.append(Finding(
+                            "SAN003", key, fi.rel, line,
+                            f"{_tok_str(tok)} is a plain Lock acquired "
+                            f"in {fi.qual}() while a caller already "
+                            f"holds it — self-deadlock (the excepthook/"
+                            f"atexit reentrancy class); use RLock or "
+                            f"move the call outside the locked region"))
+    # cycles
+    seen_cycles = set()
+    for start in sorted(graph):
+        path, on_path = [], set()
+
+        def dfs(tok):
+            if tok in on_path:
+                cyc = tuple(path[path.index(tok):] + [tok])
+                norm = frozenset(cyc)
+                if norm not in seen_cycles:
+                    seen_cycles.add(norm)
+                    rel, qual, line = sites.get(
+                        (cyc[0], cyc[1]), ("tools/dttsan", "?", 0))
+                    out.append(Finding(
+                        "SAN003",
+                        "lock-cycle:" + "->".join(
+                            sorted(_tok_str(t) for t in set(cyc))),
+                        rel, line,
+                        f"lock acquisition cycle "
+                        f"{' -> '.join(_tok_str(t) for t in cyc)} — "
+                        f"two threads taking the ends in opposite "
+                        f"order deadlock"))
+                return
+            if tok not in graph:
+                return
+            path.append(tok)
+            on_path.add(tok)
+            for nxt in sorted(graph[tok]):
+                dfs(nxt)
+            path.pop()
+            on_path.remove(tok)
+
+        dfs(start)
+    # CV discipline + blocking-while-holding
+    for fi in model.funcs.values():
+        g = model.guaranteed_entry(fi.fnid)
+        for tok, line, in_while, _held in fi.waits:
+            if not in_while:
+                out.append(Finding(
+                    "SAN003",
+                    f"wait-no-while:{fi.rel}:{fi.qual}:{_tok_str(tok)}",
+                    fi.rel, line,
+                    f"{_tok_str(tok)}.wait() outside a while-predicate "
+                    f"loop in {fi.qual}() — spurious wakeups and "
+                    f"stolen notifies make a bare wait a missed-signal "
+                    f"hang"))
+        for tok, line, in_while, held in fi.waits:
+            others = (g | held) - {tok}
+            if others:
+                out.append(Finding(
+                    "SAN003",
+                    f"wait-holding:{fi.rel}:{fi.qual}:{_tok_str(tok)}",
+                    fi.rel, line,
+                    f"{_tok_str(tok)}.wait() in {fi.qual}() releases "
+                    f"only its own lock but "
+                    f"{'/'.join(sorted(map(_tok_str, others)))} stays "
+                    f"held through the wait — anyone needing that lock "
+                    f"to produce the notify deadlocks"))
+        for tok, line, held in fi.notifies:
+            if tok not in (g | held):
+                out.append(Finding(
+                    "SAN003",
+                    f"notify-unheld:{fi.rel}:{fi.qual}:{_tok_str(tok)}",
+                    fi.rel, line,
+                    f"{_tok_str(tok)}.notify() in {fi.qual}() without "
+                    f"holding the condition — the waiter can miss the "
+                    f"signal between its predicate check and wait"))
+        for desc, held, line in fi.blocking:
+            g_all = g | held
+            if g_all:
+                out.append(Finding(
+                    "SAN003",
+                    f"blocking-held:{fi.rel}:{fi.qual}:{desc}",
+                    fi.rel, line,
+                    f"blocking call {desc}() in {fi.qual}() while "
+                    f"holding "
+                    f"{'/'.join(sorted(map(_tok_str, g_all)))} — every "
+                    f"other thread needing that lock stalls behind an "
+                    f"unbounded wait"))
+    return out
+
+
+# --------------------------------------------------------------- SAN004
+
+
+def pass_lifecycle(model: SanModel, index) -> list[Finding]:
+    out: list[Finding] = []
+    # (a) daemon/join hygiene per inventory thread/timer site
+    for r in model.roots:
+        if r.kind not in ("thread", "timer"):
+            continue
+        tree = index.trees.get(r.path)
+        if tree is None:
+            continue
+        call = _call_at(tree, r.line)
+        if call is None:
+            continue
+        daemon = any(k.arg == "daemon" and
+                     isinstance(k.value, ast.Constant) and
+                     k.value.value is True for k in call.keywords)
+        if daemon:
+            continue
+        src = index.sources.get(r.path, "")
+        release = ".cancel(" if r.kind == "timer" else ".join("
+        setter = ".daemon = True"
+        if release not in src and setter not in src:
+            out.append(Finding(
+                "SAN004", f"thread-hygiene:{r.key}", r.path, r.line,
+                f"{r.kind} {r.target!r} is neither daemon=True nor "
+                f"ever {release.strip('.(')}ed — a non-daemon thread "
+                f"without a join outlives the run (hangs interpreter "
+                f"shutdown)"))
+    # (b) stop-Event reuse across restart (the CheckpointWatcher class)
+    for ci in model.classes.values():
+        events = {a for a, t in ci.attr_types.items()
+                  if t in EVENT_TYPES}
+        if not events:
+            continue
+        starters = _thread_starters(model, ci)
+        for meth, target_fnid, line in starters:
+            if meth == "__init__":
+                continue  # one-shot construction cannot restart
+            tgt = model.funcs.get(target_fnid)
+            if tgt is None:
+                continue
+            src_tgt = _fn_source(index, tgt)
+            loop_events = {e for e in events
+                           if f"self.{e}.wait" in src_tgt
+                           or f"self.{e}.is_set" in src_tgt}
+            if not loop_events:
+                continue
+            start_src = _fn_source(index, model.funcs.get(
+                ci.methods.get(meth, ""), None))
+            set_elsewhere = any(
+                f"self.{e}.set(" in index.sources.get(ci.rel, "")
+                for e in loop_events)
+            # a restart may either clear() the event or re-point the
+            # attr at a FRESH one (the handed-to-the-thread pattern)
+            clears = any(f"self.{e}.clear(" in (start_src or "")
+                         or f"self.{e} =" in (start_src or "")
+                         for e in loop_events)
+            if set_elsewhere and not clears:
+                out.append(Finding(
+                    "SAN004",
+                    f"stop-reuse:{ci.rel}:{ci.name}.{meth}",
+                    ci.rel, line,
+                    f"{ci.name}.{meth}() can restart the worker thread "
+                    f"but never clear()s the stop Event its loop "
+                    f"conditions on — start() after close() launches a "
+                    f"thread that exits immediately (a silently dead "
+                    f"worker)"))
+    # (c) rings append-bounded
+    for ci in model.classes.values():
+        for attr, bounded in ci.ring_bounded.items():
+            if bounded:
+                continue
+            appended = any(
+                a.attr == attr and a.owner == ci.key and
+                a.kind == "write" and not a.in_init and
+                model.roots_of(a.fn)
+                for fi in model.funcs.values() for a in fi.accesses)
+            if appended:
+                out.append(Finding(
+                    "SAN004",
+                    f"ring-unbounded:{ci.rel}:{ci.name}.{attr}",
+                    ci.rel, ci.ring_lines.get(attr, ci.line),
+                    f"{ci.name}.{attr} is a deque ring appended at "
+                    f"runtime but constructed WITHOUT maxlen — a "
+                    f"monitoring/audit ring must be append-bounded by "
+                    f"construction, not by pruning logic someone can "
+                    f"break"))
+    # (d) hooks must not block (excepthook/atexit/signal run inside
+    # arbitrary interpreter states)
+    for r in model.roots:
+        if r.kind not in ("excepthook", "atexit", "signal"):
+            continue
+        for fnid in model.reach.get(r.key, ()):
+            fi = model.funcs[fnid]
+            for desc, _held, line in fi.blocking:
+                out.append(Finding(
+                    "SAN004", f"hook-blocks:{r.key}:{desc}",
+                    fi.rel, line,
+                    f"{r.kind} handler path {fi.qual}() makes blocking "
+                    f"call {desc}() — a crash/shutdown hook must not "
+                    f"wait on other threads (they may hold the very "
+                    f"locks the interpreter is tearing down)"))
+            for tok, line, in_while, _h in fi.waits:
+                out.append(Finding(
+                    "SAN004", f"hook-blocks:{r.key}:wait",
+                    fi.rel, line,
+                    f"{r.kind} handler path {fi.qual}() waits on "
+                    f"{_tok_str(tok)} — a crash/shutdown hook must "
+                    f"not block"))
+    return out
+
+
+def _thread_starters(model: SanModel, ci: ClassInfo):
+    """(method, target_fnid, line) for every Thread construction inside
+    a method of ``ci`` whose target is a self-method."""
+    out = []
+    for r in model.roots:
+        if r.kind != "thread" or r.path != ci.rel:
+            continue
+        scope_cls = r.scope.split(".", 1)[0] if r.scope else ""
+        if scope_cls != ci.name or "." not in r.scope:
+            continue
+        meth = r.scope.split(".", 1)[1].split(".", 1)[0]
+        if r.target.startswith("self."):
+            tname = r.target.split(".")[1]
+            if tname in ci.methods:
+                out.append((meth, ci.methods[tname], r.line))
+    return out
+
+
+def _call_at(tree, line: int) -> ast.Call | None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node.lineno == line:
+            chain = _dotted(node.func) or ""
+            if chain.rsplit(".", 1)[-1] in ("Thread", "Timer"):
+                return node
+    return None
+
+
+def _fn_source(index, fi) -> str:
+    if fi is None:
+        return ""
+    src = index.sources.get(fi.rel, "")
+    if not src:
+        return ""
+    lines = src.splitlines()
+    node = None
+    tree = index.trees.get(fi.rel)
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                n.lineno == fi.line:
+            node = n
+            break
+    if node is None:
+        return ""
+    return "\n".join(lines[node.lineno - 1:(node.end_lineno or
+                                            node.lineno)])

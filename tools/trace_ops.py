@@ -53,17 +53,28 @@ compiled CPU HLO), one row per collective equation with family, mesh
 axes, trips, and wire bytes — what the analytic ledger row SHOULD say,
 measured.
 
+``--threads`` prints the discovered THREAD INVENTORY — every
+concurrent entry point in the tree (Thread/Timer construction sites,
+threaded-server handler classes, excepthook/atexit/signal hooks, crash
+contexts) with file:line, the shared attributes each root's class
+touches, and the guarding locks (tools/dttsan's inventory + lock-set
+model, chip-free). The fifth sibling: memory, compute, the wire, the
+wire as lowered, and the host thread plane.
+
 The static-analysis siblings of this whole printer family are
-``python -m tools.dttlint`` (AST invariants, rules DTT001-DTT009) and
+``python -m tools.dttlint`` (AST invariants, rules DTT001-DTT010),
 ``python -m tools.dttcheck`` (jaxpr-level proofs, passes DTC001-DTC004
-— the ledger/SPMD verifier whose inventory --jaxpr prints): where
---schedule/--mem/--flops/--comm/--jaxpr PRINT the tree's static facts,
-those two ENFORCE them (docs/ARCHITECTURE.md "Static analysis" and
-"Jaxpr verification").
+— the ledger/SPMD verifier whose inventory --jaxpr prints), and
+``python -m tools.dttsan`` (the host-plane concurrency analyzer whose
+inventory --threads prints; passes SAN001-SAN004): where
+--schedule/--mem/--flops/--comm/--jaxpr/--threads PRINT the tree's
+static facts, those three ENFORCE them (docs/ARCHITECTURE.md "Static
+analysis", "Jaxpr verification", and "Concurrency analysis").
 
 Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
        python tools/trace_ops.py --schedule K M [V] [gpipe|interleaved|zb]
        python tools/trace_ops.py --faults
+       python tools/trace_ops.py --threads
        python tools/trace_ops.py --mem MODEL D [--zero Z] [--optimizer OPT]
        python tools/trace_ops.py --flops MODEL [BATCH]
        python tools/trace_ops.py --comm MODEL D [--model_axis K] [--batch B]
@@ -72,6 +83,8 @@ Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
                                  [--model_axis K] [--batch B]
        python -m tools.dttlint [--json] [--baseline PATH] [--fix]
        python -m tools.dttcheck [--json] [--mode M] [--model M]
+       python -m tools.dttsan [--json] [--baseline PATH] [--threads]
+       python -m tools.analyze [--json]
 """
 
 from __future__ import annotations
@@ -417,6 +430,26 @@ def print_jaxpr_inventory(model_name: str, d: int, mode: str = "dp",
         print(f"  {fam} over {','.join(axes)}: {_fmt_bytes(bytes_)}")
 
 
+def print_threads() -> None:
+    """Print the discovered thread inventory — every concurrent entry
+    point in the tree (Thread/Timer sites, threaded-server handler
+    classes, excepthook/atexit/signal hooks, crash contexts) with its
+    file:line, the shared ``self.*`` attributes its class touches, and
+    the locks that guard them. The fifth sibling of
+    --mem/--flops/--comm/--jaxpr: memory, compute, the wire, the wire
+    as lowered, and now the HOST THREAD PLANE — enforced by
+    ``python -m tools.dttsan`` (the concurrency analyzer whose
+    inventory this prints; registry in tools/dttsan/registry.json)."""
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.dttsan import threads_table
+    from tools.dttsan.__main__ import print_threads as _pt
+
+    _pt(threads_table())
+
+
 def print_faults() -> None:
     """List the fault-injection registry (the --fault_spec grammar's
     source of truth — utils/faults.INJECTION_POINTS)."""
@@ -458,6 +491,8 @@ if __name__ == "__main__":
         print_schedule(k, m, v, sched)
     elif sys.argv[1] == "--faults":
         print_faults()
+    elif sys.argv[1] == "--threads":
+        print_threads()
     elif sys.argv[1] == "--flops":
         print_flops(sys.argv[2],
                     int(sys.argv[3]) if len(sys.argv) > 3 else 128)
