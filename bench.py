@@ -897,6 +897,233 @@ def serving_phase() -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+# r21: continuous batching — the long-generation-adversary A/B. Both
+# arms are HOST-ONLY (HostSlotBackend charges a fixed sleep per decode
+# iteration; no jax, no chip), so every continuous_*/kv_* field stays
+# non-null in the degraded/outage record like the serving drill. The
+# arms pay the SAME per-iteration price; what differs is the schedule:
+# whole-batch commits a worker for a request's entire generation
+# (longs head-of-line-block shorts, batches fragment on the
+# (len, n, temp) group key), continuous admits/retires between
+# iterations over slots whose memory is paged — which is why the same
+# KV token budget that gives whole-batch 4 dense rows
+# (WB_BATCH x CAPACITY tokens = PAGES x PAGE) runs more continuous
+# slots: commitments track actual footprints (prompt + n - 1), not
+# capacity. The defaults are the SMOKE config (~1-2 s): the drill
+# rides every degraded/outage record and the record builder runs many
+# times under test, so the default sweep must stay cheap. The
+# adversary-scale config — longer generations, a wider rate sweep,
+# 12 slots vs 4 dense rows — lives in CONTINUOUS_BENCH_FULL and is
+# pinned by the slow-tier A/B test (>=2x knee, >=5x p99 queue_wait
+# reduction, zero drops below the knee).
+CONTINUOUS_BENCH_STEP_S = 0.0005
+CONTINUOUS_BENCH_PROMPT_LEN = 2
+CONTINUOUS_BENCH_SHORT_TOKENS = 3
+CONTINUOUS_BENCH_LONG_TOKENS = 9
+CONTINUOUS_BENCH_LONG_EVERY = 5
+CONTINUOUS_BENCH_SLOTS = 4
+CONTINUOUS_BENCH_WB_BATCH = 2
+CONTINUOUS_BENCH_CAPACITY = 24
+CONTINUOUS_BENCH_PAGE = 4
+CONTINUOUS_BENCH_PAGES = 12  # == WB_BATCH * CAPACITY tokens / PAGE
+CONTINUOUS_BENCH_RATES = (60.0, 120.0, 240.0, 480.0)
+CONTINUOUS_BENCH_DURATION_S = 0.25
+
+# the long-generation-adversary config (slow-tier A/B; see above)
+CONTINUOUS_BENCH_FULL = {
+    "CONTINUOUS_BENCH_STEP_S": 0.001,
+    "CONTINUOUS_BENCH_PROMPT_LEN": 2,
+    "CONTINUOUS_BENCH_SHORT_TOKENS": 4,
+    "CONTINUOUS_BENCH_LONG_TOKENS": 32,
+    "CONTINUOUS_BENCH_LONG_EVERY": 10,
+    "CONTINUOUS_BENCH_SLOTS": 12,
+    "CONTINUOUS_BENCH_WB_BATCH": 4,
+    "CONTINUOUS_BENCH_CAPACITY": 72,
+    "CONTINUOUS_BENCH_PAGE": 4,
+    "CONTINUOUS_BENCH_PAGES": 72,
+    "CONTINUOUS_BENCH_RATES": (
+        40.0, 80.0, 160.0, 320.0, 640.0, 960.0, 1280.0),
+    "CONTINUOUS_BENCH_DURATION_S": 1.2,
+}
+
+_CONTINUOUS_NULLS = {
+    "continuous_knee_rps": None,
+    "whole_batch_knee_rps": None,
+    "continuous_knee_ratio": None,
+    "continuous_queue_wait_p99_ms": None,
+    "whole_batch_queue_wait_p99_ms": None,
+    "continuous_queue_wait_reduction": None,
+    "continuous_drops_below_knee": None,
+    "continuous_mix": None,
+    "kv_pages_allocated": None,
+    "kv_pages_high_water": None,
+    "kv_page_ledger_ok": None,
+    "slot_occupancy": None,
+    "tokens_per_iteration": None,
+}
+
+
+def continuous_batching_phase(measured: bool = True) -> dict:
+    """Two halves, separately guarded. The ANALYTIC half drives a short
+    mixed workload through the continuous scheduler with zero step cost
+    and reports the page-ledger facts (kv_pages_allocated,
+    slot_occupancy, tokens_per_iteration — asserting the paged-cache
+    claim: KV high water tracks live tokens, not slots x capacity).
+    The MEASURED half is the knee-throughput A/B on the long-tail mix —
+    whole-batch vs continuous at equal per-iteration cost — reporting
+    each arm's knee and the p99 queue_wait at the highest rate both
+    sustain. ``measured=False`` (the degraded/outage record) keeps the
+    analytic ledger facts and leaves the knee keys null — the same
+    convention the chip-gated A/Bs use, here because a wall-clock rate
+    sweep has no place in the outage path."""
+    import numpy as np
+
+    from distributed_tensorflow_tpu.serving import reqtrace
+    from distributed_tensorflow_tpu.serving.batcher import DynamicBatcher
+    from distributed_tensorflow_tpu.serving.continuous import (
+        ContinuousBatcher,
+        HostSlotBackend,
+    )
+    from distributed_tensorflow_tpu.serving.server import (
+        generate_group_key,
+    )
+    from tools.serve_loadgen import knee_throughput, long_tail_fn
+
+    out = dict(_CONTINUOUS_NULLS)
+    short_n = CONTINUOUS_BENCH_SHORT_TOKENS
+    long_n = CONTINUOUS_BENCH_LONG_TOKENS
+    prompt = np.arange(1, CONTINUOUS_BENCH_PROMPT_LEN + 1, dtype=np.int32)
+    out["continuous_mix"] = (
+        f"1-in-{CONTINUOUS_BENCH_LONG_EVERY} long "
+        f"({long_n} tokens), rest short ({short_n})")
+
+    # ---- analytic half: the page ledger under a mixed residency
+    cb = None
+    try:
+        backend = HostSlotBackend(
+            n_slots=4, capacity=CONTINUOUS_BENCH_CAPACITY,
+            page_size=CONTINUOUS_BENCH_PAGE)
+        cb = ContinuousBatcher(backend, queue_depth=32,
+                               default_timeout_ms=30000,
+                               name="bench-cont-ledger")
+        futs = [cb.submit(prompt, max_new_tokens=(
+                    long_n if i % 3 == 2 else short_n),
+                    temperature=0.0)
+                for i in range(12)]
+        for f in futs:
+            f.result(30)
+        snap = cb.scheduler.snapshot()
+        kv = snap["kv_pages"]
+        # the paged-cache claim, analytically: pages never ran ahead of
+        # live tokens by more than the per-slot partial-page slack
+        page = CONTINUOUS_BENCH_PAGE
+        assert snap["page_ledger_ok"], "page ledger diverged from residents"
+        assert kv["pages_high_water"] * page < (
+            snap["live_tokens_high_water"] + backend.n_slots * page), (
+            f"KV high water {kv['pages_high_water']} pages exceeds the "
+            f"live-token bound ({snap['live_tokens_high_water']} tokens)")
+        out.update({
+            "kv_pages_allocated": kv["allocs_total"],
+            "kv_pages_high_water": kv["pages_high_water"],
+            "kv_page_ledger_ok": snap["page_ledger_ok"],
+            "slot_occupancy": snap["slot_occupancy"],
+            "tokens_per_iteration": snap["tokens_per_iteration"],
+        })
+    except Exception as e:  # never kill the record over the drill
+        out["continuous_error"] = f"{type(e).__name__}: {e}"[:200]
+    finally:
+        if cb is not None:
+            cb.close(drain=False)
+
+    # ---- measured half: knee-throughput A/B on the long-tail mix
+    if not measured:
+        return out
+    prev_plane = reqtrace.get_plane()
+    cont = wb = None
+    try:
+        step_cost = lambda: time.sleep(CONTINUOUS_BENCH_STEP_S)  # noqa: E731
+        # the plane supplies per-request queue_wait to the loadgen rows
+        reqtrace.configure(enabled=True, ring=256)
+
+        backend = HostSlotBackend(
+            n_slots=CONTINUOUS_BENCH_SLOTS,
+            capacity=CONTINUOUS_BENCH_CAPACITY,
+            page_size=CONTINUOUS_BENCH_PAGE,
+            num_pages=CONTINUOUS_BENCH_PAGES, step_cost=step_cost)
+        cont = ContinuousBatcher(backend, queue_depth=64,
+                                 default_timeout_ms=10000,
+                                 name="bench-cont")
+
+        def wb_runner(payloads, opts_list):
+            # whole-batch generation cost model: one prefill step plus
+            # n decode steps, batch-wide — the batch runs as long as
+            # its generation length whatever its width
+            n = int(opts_list[0].get("max_new_tokens", 16))
+            for _ in range(n + 1):
+                step_cost()
+            return [np.zeros(len(p) + n, np.int32) for p in payloads]
+
+        wb = DynamicBatcher(wb_runner, group_key=generate_group_key,
+                            max_batch=CONTINUOUS_BENCH_WB_BATCH,
+                            max_delay_ms=2.0, queue_depth=64,
+                            default_timeout_ms=10000, name="bench-wb")
+
+        def mk(batcher, n):
+            def call():
+                f = batcher.submit(prompt, max_new_tokens=n,
+                                   temperature=0.0)
+                f.result(15)
+                meta = f.meta or {}
+                return {"request_id": meta.get("request_id"),
+                        "phases_ms": meta.get("phases_ms")}
+            return call
+
+        reps = {}
+        for arm, b in (("whole_batch", wb), ("continuous", cont)):
+            fn = long_tail_fn(mk(b, short_n), mk(b, long_n),
+                              long_every=CONTINUOUS_BENCH_LONG_EVERY)
+            reps[arm] = knee_throughput(
+                fn, CONTINUOUS_BENCH_RATES,
+                duration_s=CONTINUOUS_BENCH_DURATION_S)
+
+        wb_knee = reps["whole_batch"]["knee_rps"]
+        cont_knee = reps["continuous"]["knee_rps"]
+        # compare tails at the highest rate BOTH arms sustain — the
+        # honest rate: neither arm is in collapse there
+        wb_sust = {r["offered_rps"]
+                   for r in reps["whole_batch"]["sweep"] if r["sustained"]}
+        common = [r for r in reps["continuous"]["sweep"]
+                  if r["sustained"] and r["offered_rps"] in wb_sust]
+        qw_c = qw_w = None
+        if common:
+            rate = common[-1]["offered_rps"]
+            qw_c = common[-1]["queue_wait_p99_ms"]
+            qw_w = next(r for r in reps["whole_batch"]["sweep"]
+                        if r["offered_rps"] == rate)["queue_wait_p99_ms"]
+        out.update({
+            "continuous_knee_rps": cont_knee,
+            "whole_batch_knee_rps": wb_knee,
+            "continuous_knee_ratio": (
+                round(cont_knee / wb_knee, 3) if wb_knee else None),
+            "continuous_queue_wait_p99_ms": qw_c,
+            "whole_batch_queue_wait_p99_ms": qw_w,
+            "continuous_queue_wait_reduction": (
+                round(qw_w / max(qw_c, 1e-3), 2)
+                if qw_w is not None and qw_c is not None else None),
+            "continuous_drops_below_knee": sum(
+                r["rejected"] + r["errors"]
+                for r in reps["continuous"]["sweep"] if r["sustained"]),
+        })
+    except Exception as e:  # never kill the record over the drill
+        out["continuous_ab_error"] = f"{type(e).__name__}: {e}"[:200]
+    finally:
+        for b in (cont, wb):
+            if b is not None:
+                b.close(drain=False)
+        reqtrace._PLANE = prev_plane
+    return out
+
+
 # r11: telemetry phases. The span overhead and the breakdown-machinery
 # drill are HOST-ONLY (stdlib telemetry, no chip) so the observability
 # trajectory keeps evidence through tunnel outages, like the recovery
@@ -2225,6 +2452,11 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
     # and its overhead_pct stays null here)
     out.update(recovery_phase())
     out.update(serving_phase())
+    # r21: the continuous-batching page-ledger facts are analytic
+    # (zero-step-cost drill) and stay non-null in outages; the knee
+    # A/B is a wall-clock rate sweep and stays null here, like the
+    # chip-gated A/Bs
+    out.update(continuous_batching_phase(measured=False))
     # r19: the request-plane drill rides the same host-only contract —
     # reqtrace_* facts stay non-null in EVERY record incl. outages
     out.update(reqtrace_phase())
@@ -2360,6 +2592,9 @@ def _run_phases(out: dict):
     # r9: the serving drill (host-only for the same reason) — offered
     # load through the real engine/batcher/hot-reload machinery
     out.update(serving_phase())
+    # r21: continuous batching vs whole-batch on the long-tail mix
+    # (host-only A/B at equal per-iteration cost) + page-ledger facts
+    out.update(continuous_batching_phase())
     # r19: the request-plane drill (host-only) — per-request phase
     # timelines, tail attribution, and SLO compliance through the
     # armed plane, with the on-vs-off serving A/B

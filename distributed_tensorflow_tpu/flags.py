@@ -672,6 +672,31 @@ def define_serving_flags():
                    "exemplars (request_id + phase breakdown, by total "
                    "latency) the /metrics tail block names; must be "
                    "in [1, 64]")
+    DEFINE_string("serve_scheduler", "whole_batch", "Generate-route "
+                  "scheduler: 'whole_batch' (DynamicBatcher — one "
+                  "microbatch committed for its entire generation) or "
+                  "'continuous' (iteration-level slot scheduler over a "
+                  "paged KV cache, serving/continuous.py — requests "
+                  "admit/retire between decode steps, greedy outputs "
+                  "bitwise identical to whole_batch). Continuous "
+                  "serves --model lm, one replica per device (no "
+                  "--serve_tp)")
+    DEFINE_integer("serve_slots", 4, "Continuous scheduler: fixed "
+                   "number of batch slots (concurrent in-flight "
+                   "generations). Must be >= 2 — slot width >= 2 keeps "
+                   "the decode contractions on the GEMM kernel, the "
+                   "same bitwise-parity floor the whole-batch decode "
+                   "enforces")
+    DEFINE_integer("serve_kv_page", 16, "Continuous scheduler: tokens "
+                   "per KV-cache page; must divide --seq_len (a slot's "
+                   "logical pages tile the context window exactly)")
+    DEFINE_integer("serve_kv_pages", 0, "Continuous scheduler: physical "
+                   "KV pages in the pool. 0 = full provisioning "
+                   "(serve_slots * seq_len / serve_kv_page — every slot "
+                   "can hold a max-length request); smaller pools "
+                   "oversubscribe slots against pages and admission "
+                   "gates on the page commitment. Must hold at least "
+                   "one full-context request (seq_len / serve_kv_page)")
     FLAGS._register_validator(_validate_serving_flags)
     FLAGS._register_validator(_validate_reqtrace_flags)
 
@@ -899,6 +924,48 @@ def _validate_serving_flags(values: dict):
         if d_model and d_model % tp:
             raise ValueError(
                 f"--serve_tp={tp} must divide --d_model={d_model}")
+    sched = values.get("serve_scheduler")
+    if sched is not None:
+        if sched not in ("whole_batch", "continuous"):
+            raise ValueError(
+                f"--serve_scheduler={sched!r} must be one of "
+                f"whole_batch, continuous")
+        slots = values.get("serve_slots")
+        if slots is not None and int(slots) < 2:
+            raise ValueError(
+                f"--serve_slots={slots} must be >= 2 (slot width >= 2 "
+                f"keeps decode on the GEMM kernel — the bitwise-parity "
+                f"floor)")
+        page = values.get("serve_kv_page")
+        if page is not None and int(page) < 1:
+            raise ValueError(f"--serve_kv_page={page} must be >= 1")
+        seq_len = int(values.get("seq_len") or 0)
+        if page is not None and seq_len and seq_len % int(page):
+            raise ValueError(
+                f"--serve_kv_page={page} must divide --seq_len="
+                f"{seq_len} (a slot's pages tile the context window)")
+        pages = values.get("serve_kv_pages")
+        if pages is not None and int(pages) < 0:
+            raise ValueError(
+                f"--serve_kv_pages={pages} must be >= 0 "
+                f"(0 = full provisioning)")
+        if pages and page and seq_len:
+            per_slot = -(-seq_len // int(page))
+            if int(pages) < per_slot:
+                raise ValueError(
+                    f"--serve_kv_pages={pages} cannot hold one "
+                    f"full-context request ({per_slot} pages of "
+                    f"{page} tokens for --seq_len={seq_len})")
+        if sched == "continuous":
+            model = values.get("model")
+            if model is not None and model != "lm":
+                raise ValueError(
+                    f"--serve_scheduler=continuous serves --model lm "
+                    f"only (token decode); got --model={model!r}")
+            if tp > 1:
+                raise ValueError(
+                    "--serve_scheduler=continuous serves one replica "
+                    "per device; --serve_tp > 1 is whole_batch only")
     # prompt-vs-context fit is a PER-REQUEST property (prompt lengths
     # vary); decode.generate enforces it loudly at request time
 

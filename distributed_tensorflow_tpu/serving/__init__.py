@@ -19,6 +19,16 @@ from distributed_tensorflow_tpu.serving.batcher import (
     RejectedError,
     pow2_bucket,
 )
+from distributed_tensorflow_tpu.serving.continuous import (
+    ContinuousBatcher,
+    ContinuousScheduler,
+    EngineSlotBackend,
+    HostSlotBackend,
+)
+from distributed_tensorflow_tpu.serving.kvpage import (
+    PageAllocator,
+    pages_needed,
+)
 from distributed_tensorflow_tpu.serving.reqtrace import (
     RequestPlane,
     SLOLedger,
@@ -41,12 +51,17 @@ from distributed_tensorflow_tpu.serving.server import (
 
 __all__ = [
     "CheckpointWatcher",
+    "ContinuousBatcher",
+    "ContinuousScheduler",
     "DynamicBatcher",
+    "EngineSlotBackend",
     "Future",
+    "HostSlotBackend",
     "InferenceEngine",
     "InferenceServer",
     "InProcessClient",
     "NoCheckpointError",
+    "PageAllocator",
     "RejectedError",
     "RequestPlane",
     "SLOLedger",
@@ -55,6 +70,7 @@ __all__ = [
     "make_generate_runner",
     "make_predict_runner",
     "new_request_id",
+    "pages_needed",
     "pow2_bucket",
     "predict_group_key",
     "reqtrace",

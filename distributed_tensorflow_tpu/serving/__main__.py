@@ -73,10 +73,14 @@ def build_serving_stack(FLAGS):
     tp = int(FLAGS.serve_tp) > 1
     import jax
 
-    if tp or len(jax.devices()) > 1:
+    continuous = FLAGS.serve_scheduler == "continuous"
+    if (tp or len(jax.devices()) > 1) and not continuous:
         from distributed_tensorflow_tpu.parallel import MeshSpec, make_mesh
 
         mesh = make_mesh(MeshSpec(data=-1, model=int(FLAGS.serve_tp)))
+    # continuous mode serves one replica per device: no mesh, pools
+    # live on the default device (the flag validator already rejects
+    # --serve_tp > 1 with it)
     engine = InferenceEngine(model, FLAGS.logdir, mesh=mesh, tp=tp,
                              max_batch=FLAGS.serve_max_batch)
     # resource plane (r13): the replica's memory meter + compile sentry
@@ -128,11 +132,32 @@ def build_serving_stack(FLAGS):
         gen_metrics = ServingMetrics(logger, engine, name="generate",
                                      emit_every=FLAGS.serve_metrics_every,
                                      profiler=profiler)
-        generate_b = DynamicBatcher(
-            make_generate_runner(engine), group_key=generate_group_key,
-            latency=StreamingHistogram(),
-            on_batch=gen_metrics.on_batch,
-            name="generate", **common)
+        if continuous:
+            # r21: iteration-level slot scheduler over the paged KV
+            # cache — same Future/stats/expiry surface, selected here
+            # and nowhere else
+            from distributed_tensorflow_tpu.serving.continuous import (
+                ContinuousBatcher,
+                EngineSlotBackend,
+            )
+
+            backend = EngineSlotBackend(
+                engine, n_slots=FLAGS.serve_slots,
+                page_size=FLAGS.serve_kv_page,
+                num_pages=FLAGS.serve_kv_pages)
+            generate_b = ContinuousBatcher(
+                backend, queue_depth=FLAGS.serve_queue_depth,
+                default_timeout_ms=FLAGS.serve_timeout_ms,
+                latency=StreamingHistogram(),
+                on_iteration=gen_metrics.on_batch,
+                name="generate")
+        else:
+            generate_b = DynamicBatcher(
+                make_generate_runner(engine),
+                group_key=generate_group_key,
+                latency=StreamingHistogram(),
+                on_batch=gen_metrics.on_batch,
+                name="generate", **common)
     # both batchers ride the CONSTRUCTOR: a post-construction attribute
     # write would race HTTP handler threads already reading the client
     # once the server starts (dttsan SAN002)

@@ -238,8 +238,10 @@ class DynamicBatcher:
                           f"retry later")
                 reqtrace.finish(tr, "rejected_full", reason=reason)
                 raise RejectedError(reason, request_id=rid)
+            with self.stats.lock:
+                admit_count = self.stats.admitted + 1
             try:
-                fault_point("serve_admit", count=self.stats.admitted + 1)
+                fault_point("serve_admit", count=admit_count)
             except Exception as e:
                 with self.stats.lock:
                     self.stats.rejected_fault += 1

@@ -169,6 +169,118 @@ def make_decode_step(model, jit: bool = True):
     return step
 
 
+def make_slot_pools(model, page_size: int, num_pages: int):
+    """Device KV pools for the paged slot step: a tuple, one
+    ``(k_pool, v_pool)`` pair per block, each
+    ``(num_pages + 1, page_size, H, Dh)`` zeros in the cache dtype.
+
+    Row 0 is the reserved SCRATCH page: a free slot's page-table row is
+    all zeros, so its (masked, discarded) reads and its writes land
+    here instead of clobbering a live request's pages. One extra row
+    buys a branch-free step — no "is this slot live" select inside the
+    traced computation."""
+    check_decodable(model)
+    import jax.numpy as jnp
+
+    cd = model.compute_dtype
+    dh = model.d_model // model.num_heads
+    dtype = cd if cd is not None else jnp.float32
+    shape = (num_pages + 1, page_size, model.num_heads, dh)
+    return tuple((jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                 for _ in range(model.num_blocks))
+
+
+def make_slot_step(model, page_size: int, jit: bool = True):
+    """(params, pools, page_table (S, P) i32, tok (S,) i32, t (S,) i32)
+    -> (logits (S, V) f32, pools) — one iteration-level decode tick over
+    ``S`` independent slots against a PAGED KV cache.
+
+    The continuous scheduler's single traced computation (r21). Each
+    slot ``i`` feeds token ``tok[i]`` at its own absolute position
+    ``t[i]``; ``page_table[i, j]`` names the physical pool row backing
+    logical page ``j`` of slot ``i`` (0 = the scratch page for
+    free/unmapped entries — see ``make_slot_pools``). The step scatters
+    the new (k, v) into ``pools[block][t // page_size][t % page_size]``
+    and attends each slot's query against its GATHERED dense view
+    ``pool[page_table].reshape(S, capacity, H, Dh)``.
+
+    Bitwise contract: the body is ``make_decode_step`` verbatim — same
+    einsum strings, same dtype-cast order, same scale placement, same
+    width->=2 p@V trick — with the batch's shared scalar ``t`` widened to
+    a per-slot vector and the dense cache update replaced by the
+    page scatter/gather (index plumbing: gathers and scatters move
+    bits, they do not do arithmetic). Free slots run the same ops
+    against scratch garbage: every score beyond ``t[i]`` is masked to
+    ``-inf`` pre-softmax (exact 0.0 probability) and a free slot's
+    logits row is discarded by the scheduler, so garbage cannot reach a
+    live request. ``S >= 2`` keeps every contraction on the GEMM (not
+    GEMV) kernel, whose per-row reduction order is row-count
+    independent — the property the whole-batch pin already relies on.
+
+    Shapes are static (slot count, page table, pools), so continuous
+    mode adds exactly ONE traced signature however requests come and
+    go. Pools are DONATED under jit — updated in place
+    dispatch-to-dispatch."""
+    check_decodable(model)
+    import jax
+    import jax.numpy as jnp
+
+    cd = model.compute_dtype
+    capacity = model.seq_len
+    dh = model.d_model // model.num_heads
+    if page_size < 1 or capacity % page_size:
+        raise ValueError(
+            f"page_size ({page_size}) must be >= 1 and divide the cache "
+            f"capacity ({capacity}) so a slot's logical pages tile it "
+            f"exactly")
+
+    def step(params, pools, page_table, tok, t):
+        s_count = tok.shape[0]
+        h = jnp.take(params["tok"], tok[:, None], axis=0)  # (S, 1, d)
+        pos_t = jnp.take(params["pos"], t, axis=0)[:, None, :]
+        h = h + pos_t.astype(h.dtype)
+        if cd is not None:
+            h = h.astype(cd)
+        # row t[i] of the causal mask per slot, full cache width
+        mask = (jnp.arange(capacity)[None, :] <= t[:, None])[:, None, None, :]
+        rows = jnp.arange(s_count)
+        dest = page_table[rows, t // page_size]  # (S,) physical pages
+        offset = t % page_size
+        new_pools = []
+        for blk, (k_pool, v_pool) in zip(params["blocks"], pools):
+            y = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
+            qkv = jnp.einsum("bsd,dthe->tbshe", y,
+                             blk["qkv"].astype(y.dtype))
+            k_pool = k_pool.at[dest, offset].set(
+                qkv[1][:, 0].astype(k_pool.dtype))
+            v_pool = v_pool.at[dest, offset].set(
+                qkv[2][:, 0].astype(v_pool.dtype))
+            k_cache = k_pool[page_table].reshape(
+                s_count, capacity, model.num_heads, dh)
+            v_cache = v_pool[page_table].reshape(
+                s_count, capacity, model.num_heads, dh)
+            s = jnp.einsum("bqhd,bkhd->bhqk", qkv[0],
+                           k_cache).astype(jnp.float32)
+            s = s / jnp.sqrt(jnp.float32(dh))
+            s = jnp.where(mask, s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            # width-2 p @ V — see the GEMV note in make_decode_step
+            p2 = jnp.concatenate([p, p], axis=2).astype(qkv[0].dtype)
+            a = jnp.einsum("bhqk,bkhd->bqhd", p2, v_cache)[:, :1]
+            a = a.reshape(*a.shape[:2], -1)  # (S, 1, H*Dh)
+            h = h + nn.dense(a, blk["proj"], compute_dtype=cd)
+            h = _mlp_half(h, blk, cd)
+            new_pools.append((k_pool, v_pool))
+        h = _layernorm(h, params["ln_f"]["g"], params["ln_f"]["b"])
+        logits = nn.dense(h, params["head"]["w"], params["head"]["b"],
+                          compute_dtype=cd)
+        return logits.astype(jnp.float32)[:, 0], tuple(new_pools)
+
+    if jit:
+        return jax.jit(step, donate_argnums=(1,))
+    return step
+
+
 def generate(model, params, prompts, max_new_tokens: int, *,
              temperature: float = 0.0, rng=None,
              prefill_fn=None, step_fn=None):

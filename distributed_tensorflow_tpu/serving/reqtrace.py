@@ -112,7 +112,8 @@ class RequestTrace:
 
     __slots__ = ("plane", "request_id", "route", "bucket", "wall0", "t0",
                  "t_admitted", "t_taken", "t_run0", "t_run1", "noted",
-                 "decode_ticks", "summary")
+                 "decode_ticks", "summary", "slot", "iter_admit",
+                 "iter_retire")
 
     def __init__(self, plane, request_id: str, route: str, bucket: int):
         self.plane = plane
@@ -128,6 +129,12 @@ class RequestTrace:
         self.noted: dict = {}
         self.decode_ticks = 0
         self.summary = None
+        # continuous batching (r21): which batch slot served the
+        # request and at which scheduler iterations it entered/left —
+        # None under the whole-batch scheduler
+        self.slot = None
+        self.iter_admit = None
+        self.iter_retire = None
 
     def admitted(self) -> None:
         self.t_admitted = time.monotonic()
@@ -325,6 +332,10 @@ class RequestPlane:
             "decode_ticks": tr.decode_ticks,
             "t_wall": tr.wall0,
         }
+        if tr.slot is not None:
+            summary["slot"] = tr.slot
+            summary["iter_admit"] = tr.iter_admit
+            summary["iter_retire"] = tr.iter_retire
         tr.summary = summary
         ok = disposition == "ok"
         with self._lock:
@@ -374,11 +385,17 @@ class RequestPlane:
             telemetry.record_span(f"req:{phase}",
                                   ts=tr.wall0 + starts[phase],
                                   dur_s=phases[phase], **attrs)
+        # continuous batching (r21): the slot story rides the summary so
+        # the offline report can tell which slot served the request and
+        # how many scheduler iterations it was resident
+        slot_attrs = ({"slot": tr.slot, "iter_admit": tr.iter_admit,
+                       "iter_retire": tr.iter_retire}
+                      if tr.slot is not None else {})
         tracer.record_instant(
             "req:done", request_id=tr.request_id, route=tr.route,
             bucket=tr.bucket, disposition=summary["disposition"],
             reason=summary["reason"], total_ms=summary["total_ms"],
-            decode_ticks=tr.decode_ticks,
+            decode_ticks=tr.decode_ticks, **slot_attrs,
             **{f"{k}_ms": v for k, v in summary["phases_ms"].items()})
 
     # --------------------------------------------------------- reports
@@ -479,6 +496,39 @@ def note_phase(phase: str, dur_s: float, ticks: int | None = None) -> None:
     ``batch_context`` (direct engine calls, tests)."""
     for t in getattr(_CTX, "traces", ()):
         t.note(phase, dur_s, ticks)
+
+
+def note_slot_admit(tr, iteration: int, slot: int) -> None:
+    """Continuous batching (r21): mark the iteration-level admission of
+    a request into batch slot ``slot``. Emits a LIVE ``req:slot_admit``
+    instant (unlike the backdated phase spans, slot events are visible
+    while the request is still decoding) and stamps the trace so the
+    finish summary carries the slot story. ``tr`` is the request's
+    ``RequestTrace`` or None; the stamp is lock-free by the same
+    lifecycle sequencing as ``taken``/``run_start`` (submit hands the
+    request to exactly one scheduler thread through the batcher cv)."""
+    if tr is not None:
+        tr.slot = int(slot)
+        tr.iter_admit = int(iteration)
+    tracer = telemetry.get_tracer()
+    if tr is not None and tracer.enabled:
+        tracer.record_instant("req:slot_admit", request_id=tr.request_id,
+                              route=tr.route, iteration=int(iteration),
+                              slot=int(slot))
+
+
+def note_slot_retire(tr, iteration: int) -> None:
+    """Continuous batching (r21): mark the iteration-level retirement of
+    a request from its batch slot (generation complete or the request
+    failed mid-flight). Live instant + trace stamp, mirror of
+    ``note_slot_admit`` (same lifecycle-sequenced ``tr``)."""
+    if tr is not None:
+        tr.iter_retire = int(iteration)
+    tracer = telemetry.get_tracer()
+    if tr is not None and tracer.enabled:
+        tracer.record_instant("req:slot_retire", request_id=tr.request_id,
+                              route=tr.route, iteration=int(iteration),
+                              slot=tr.slot)
 
 
 def finish(tr: RequestTrace | None, disposition: str,

@@ -79,7 +79,7 @@ class InProcessClient:
     wire) instead of monopolizing the batch worker."""
 
     def __init__(self, predict_batcher: DynamicBatcher | None = None,
-                 generate_batcher: DynamicBatcher | None = None, *,
+                 generate_batcher=None, *,  # Dynamic- or ContinuousBatcher
                  default_max_new_tokens: int = 16,
                  max_new_tokens_cap: int | None = None,
                  default_temperature: float = 0.0):
@@ -449,6 +449,16 @@ class InferenceServer:
             "per_device": per_device,
         }
 
+    def _kv_block(self) -> dict | None:
+        """Paged KV-cache occupancy (r21): the free-list allocator's
+        snapshot from any continuous-mode batcher. None under the
+        whole-batch scheduler (dense cache — nothing page-allocated)."""
+        for _name, b in self._batchers():
+            sched = getattr(b, "scheduler", None)
+            if sched is not None:
+                return sched.allocator.occupancy()
+        return None
+
     def healthz(self) -> dict:
         """The per-replica health signal a router/load-balancer polls:
         liveness (every configured batcher still has a worker), the
@@ -474,7 +484,14 @@ class InferenceServer:
         # trips.
         plane = reqtrace.get_plane()
         slo_burn = bool(plane is not None and plane.fast_burn_breach())
-        return {"ok": not closed and not low and not slo_burn,
+        # paged KV cache (r21): the same drain floor judges the page
+        # pool — a replica whose uncommitted pages fall below the floor
+        # is about to refuse admissions, so drain it first
+        kv = self._kv_block()
+        kv_low = bool(kv is not None and self.hbm_headroom_floor_pct > 0
+                      and kv["free_pct"] < self.hbm_headroom_floor_pct)
+        return {"ok": not closed and not low and not slo_burn
+                and not kv_low,
                 "step": self.engine.step,
                 "params_step": self.engine.step,
                 "closed_batchers": closed,
@@ -482,6 +499,9 @@ class InferenceServer:
                 "hbm_headroom_pct": (hbm["headroom_pct"]
                                      if hbm is not None else None),
                 "hbm_low_headroom": low,
+                "kv_page_free_pct": (kv["free_pct"] if kv is not None
+                                     else None),
+                "kv_low_pages": kv_low,
                 "slo_fast_burn": slo_burn,
                 "uptime_s": round(time.monotonic() - self._t0, 3)}
 
@@ -561,6 +581,12 @@ class InferenceServer:
         # resource plane (r13): the replica's memory block + compile
         # counters — what the router reads next to the health trend
         out["hbm"] = self._hbm_block()
+        # paged KV cache (r21): page-pool occupancy rides the hbm block
+        # — it IS device memory accounting, just allocator-grained (a
+        # meterless CPU replica still gets a dict with the kv story)
+        kv = self._kv_block()
+        if kv is not None:
+            out["hbm"] = {**(out["hbm"] or {}), "kv_pages": kv}
         snt = (self.resources.sentry if self.resources is not None
                else None)
         out["compiles_total"] = (float(snt.compiles_total)
@@ -590,6 +616,11 @@ class InferenceServer:
                 "rejected_full": stats["rejected_full"],
             }
             entry["health"] = self._health_block(name, stats, b)
+            sched = getattr(b, "scheduler", None)
+            if sched is not None:
+                # continuous mode (r21): iteration-level counters —
+                # slot occupancy, tokens/iteration, page ledger
+                entry["continuous"] = sched.snapshot()
             out[name] = entry
         return out
 

@@ -618,6 +618,8 @@ def test_serving_metrics_land_in_jsonl_sinks(tmp_path, lm_ckpt):
                for f in os.listdir(logdir))
 
 
+@pytest.mark.slow  # compiles a full predict bucket just to fill the
+# trace window — the capture machinery itself is covered without it
 def test_serve_profile_trace_capture(tmp_path, lm_ckpt):
     d, model, _ = lm_ckpt
     from distributed_tensorflow_tpu.utils.profiling import (
@@ -650,6 +652,8 @@ def test_bench_serving_phase_fields_non_null():
     assert rec["serving_p50_ms"] <= rec["serving_p99_ms"]
 
 
+@pytest.mark.slow  # runs every host-only bench drill end-to-end (~35 s);
+# the per-phase field contracts have their own tier-1 tests
 def test_bench_degraded_record_keeps_serving_fields(monkeypatch):
     import bench
 

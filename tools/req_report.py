@@ -14,7 +14,9 @@ response body anyone kept:
 - **Waterfalls.** Requests grouped by ``request_id``; each renders as
   its ordered phase segments (admit / queue_wait / batch_assembly /
   prefill / decode / respond) with offsets — ``--request ID`` shows one
-  in detail.
+  in detail. Under the continuous scheduler (r21) the live
+  ``req:slot_admit`` / ``req:slot_retire`` instants render as point
+  marks on the waterfall (slot + scheduler iteration), never as phases.
 - **Completeness.** A finished request must have a ``req:done`` record
   and the phase spans its disposition implies (an "ok" without a
   ``respond`` span is a hole in the plane). Incomplete timelines are
@@ -62,6 +64,11 @@ from tools.trace_view import load_records  # noqa: E402
 PHASE_ORDER = ("admit", "queue_wait", "batch_assembly", "prefill",
                "decode", "respond")
 
+# continuous batching (r21): iteration-level scheduler events — these
+# are INSTANTS (slot admission/retirement marks), not phase segments,
+# so they join the waterfall as point annotations, never the phase math
+MARKS = ("req:slot_admit", "req:slot_retire")
+
 # the phases a disposition's timeline must include to count complete
 # (beyond them, what a request has depends on where it died)
 REQUIRED_PHASES = {
@@ -96,7 +103,8 @@ def collect_requests(records: list[dict]) -> dict[str, dict]:
             "request_id": rid, "route": r.get("route"),
             "bucket": r.get("bucket"), "disposition": None,
             "reason": None, "total_ms": None, "decode_ticks": 0,
-            "t_wall": None, "phases": {}, "done": False})
+            "t_wall": None, "phases": {}, "marks": [], "done": False,
+            "slot": None, "iter_admit": None, "iter_retire": None})
         if name == "req:done":
             req["done"] = True
             req["disposition"] = r.get("disposition")
@@ -104,6 +112,15 @@ def collect_requests(records: list[dict]) -> dict[str, dict]:
             req["total_ms"] = r.get("total_ms")
             req["decode_ticks"] = r.get("decode_ticks", 0)
             req["t_wall"] = float(r.get("ts", 0.0))
+            req["slot"] = r.get("slot")
+            req["iter_admit"] = r.get("iter_admit")
+            req["iter_retire"] = r.get("iter_retire")
+        elif name in MARKS:
+            req["marks"].append({
+                "mark": name[len("req:"):],
+                "ts": float(r.get("ts", 0.0)),
+                "iteration": r.get("iteration"),
+                "slot": r.get("slot")})
         else:
             phase = name[len("req:"):]
             req["phases"][phase] = {
@@ -249,17 +266,30 @@ def chrome_trace_per_request(requests: dict[str, dict]) -> dict:
                          "route": req["route"],
                          "bucket": req["bucket"],
                          "disposition": req["disposition"]}})
+        for m in req.get("marks", ()):
+            events.append({
+                "name": f"req:{m['mark']}", "ph": "i", "s": "t",
+                "pid": 1, "tid": i, "ts": m["ts"] * 1e6,
+                "cat": "reqtrace",
+                "args": {"request_id": req["request_id"],
+                         "iteration": m["iteration"],
+                         "slot": m["slot"]}})
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 def waterfall_lines(req: dict) -> list[str]:
     t0 = min((seg["ts"] for seg in req["phases"].values()),
              default=req["t_wall"] or 0.0)
-    lines = [f"request {req['request_id']}  route={req['route']} "
-             f"bucket={req['bucket']} disposition={req['disposition']}"
-             + (f" reason={req['reason']!r}" if req["reason"] else "")
-             + (f" total={req['total_ms']:.3f}ms"
-                if req["total_ms"] is not None else "")]
+    head = (f"request {req['request_id']}  route={req['route']} "
+            f"bucket={req['bucket']} disposition={req['disposition']}"
+            + (f" reason={req['reason']!r}" if req["reason"] else "")
+            + (f" total={req['total_ms']:.3f}ms"
+               if req["total_ms"] is not None else ""))
+    if req.get("slot") is not None:
+        # continuous scheduler: which slot + how many iterations resident
+        head += (f"  slot={req['slot']} "
+                 f"iters={req['iter_admit']}..{req['iter_retire']}")
+    lines = [head]
     for phase in PHASE_ORDER:
         seg = req["phases"].get(phase)
         if seg is None:
@@ -269,6 +299,11 @@ def waterfall_lines(req: dict) -> list[str]:
                  if phase == "decode" and req["decode_ticks"] else "")
         lines.append(f"  +{off:9.3f}ms  {phase:<15} "
                      f"{seg['dur_ms']:9.3f}ms{extra}")
+    for m in sorted(req.get("marks", ()), key=lambda m: m["ts"]):
+        # iteration-level marks are instants: offset + annotation, no dur
+        off = (m["ts"] - t0) * 1e3
+        lines.append(f"  +{off:9.3f}ms  * {m['mark']:<13} "
+                     f"iteration={m['iteration']} slot={m['slot']}")
     return lines
 
 

@@ -204,6 +204,65 @@ def run_open_loop(request_fn, *, rate_rps: float, duration_s: float,
     return out
 
 
+def long_tail_fn(short_fn, long_fn, *, long_every: int = 10):
+    """The long-generation adversary: a bimodal mix where every
+    ``long_every``-th request is the long closure (default 10 → 90%
+    short / 10% long). A whole-batch scheduler pays the long request's
+    full decode on every batch that contains one — head-of-line
+    blocking the iteration-level scheduler exists to remove. The
+    counter is lock-guarded so open-loop firing threads can't skew the
+    mix."""
+    if long_every < 2:
+        raise ValueError(f"long_every must be >= 2, got {long_every}")
+    lock = threading.Lock()
+    count = [0]
+
+    def call():
+        with lock:
+            count[0] += 1
+            is_long = count[0] % long_every == 0
+        return (long_fn if is_long else short_fn)()
+
+    return call
+
+
+def knee_throughput(request_fn, rates, *, duration_s: float = 2.0,
+                    min_goodput: float = 0.95,
+                    slo_p99_ms: float | None = None) -> dict:
+    """Ascending open-loop rate sweep; the knee is the highest offered
+    rate the system SUSTAINS — zero drops (rejected + errors == 0) and
+    achieved ≥ ``min_goodput`` × offered. Stops one rate past the first
+    failure (the collapse row stays in the sweep: the report shows the
+    knee AND what falls off it). Each row carries queue_wait p99 from
+    the server's phase breakdown when the request plane is armed —
+    that's the column the continuous-vs-whole-batch A/B argues with."""
+    sweep = []
+    knee = 0.0
+    for rate in sorted(float(r) for r in rates):
+        rep = run_open_loop(request_fn, rate_rps=rate,
+                            duration_s=duration_s, slo_p99_ms=slo_p99_ms)
+        dropped = rep["rejected"] + rep["errors"]
+        sustained = (dropped == 0
+                     and rep["achieved_rps"] >= min_goodput * rate)
+        qw = (rep.get("phase_ms") or {}).get("queue_wait")
+        sweep.append({
+            "offered_rps": rate,
+            "achieved_rps": rep["achieved_rps"],
+            "ok": rep["ok"],
+            "rejected": rep["rejected"],
+            "errors": rep["errors"],
+            "latency_ms_p99": rep.get("latency_ms_p99"),
+            "queue_wait_p99_ms": qw["p99"] if qw else None,
+            "sustained": sustained,
+        })
+        if sustained:
+            knee = rate
+        else:
+            break
+    return {"knee_rps": knee, "min_goodput": min_goodput,
+            "duration_s": duration_s, "sweep": sweep}
+
+
 def http_request_fn(url: str, kind: str, *, prompt_len: int = 8,
                     vocab_size: int = 64, input_dim: int = 784,
                     max_new_tokens: int = 16):
@@ -269,6 +328,21 @@ def main():
     ap.add_argument("--vocab_size", type=int, default=64)
     ap.add_argument("--input_dim", type=int, default=784)
     ap.add_argument("--max_new_tokens", type=int, default=16)
+    ap.add_argument("--mix", choices=("uniform", "long_tail"),
+                    default="uniform",
+                    help="long_tail: every --long_every-th generate "
+                         "request asks for --long_tokens new tokens "
+                         "(default 8x --max_new_tokens) — the "
+                         "long-generation adversary")
+    ap.add_argument("--long_every", type=int, default=10,
+                    help="long_tail: 1-in-N requests are long")
+    ap.add_argument("--long_tokens", type=int, default=0,
+                    help="long_tail: long-request generation length "
+                         "(0 = 8x --max_new_tokens)")
+    ap.add_argument("--knee_rates", type=str, default="",
+                    help="comma-separated offered rps ladder; when set, "
+                         "runs the ascending knee-throughput sweep "
+                         "instead of --mode")
     ap.add_argument("--slo_p99_ms", type=float, default=0.0,
                     help="if > 0, add client-judged SLO compliance "
                          "(slo_compliant_pct) to the summary")
@@ -278,14 +352,29 @@ def main():
                          vocab_size=args.vocab_size,
                          input_dim=args.input_dim,
                          max_new_tokens=args.max_new_tokens)
+    if args.mix == "long_tail":
+        if args.kind != "generate":
+            ap.error("--mix long_tail requires --kind generate")
+        long_n = args.long_tokens or 8 * args.max_new_tokens
+        long = http_request_fn(args.url, args.kind,
+                               prompt_len=args.prompt_len,
+                               vocab_size=args.vocab_size,
+                               input_dim=args.input_dim,
+                               max_new_tokens=long_n)
+        fn = long_tail_fn(fn, long, long_every=args.long_every)
     slo = args.slo_p99_ms if args.slo_p99_ms > 0 else None
-    if args.mode == "closed":
+    if args.knee_rates:
+        rates = [float(r) for r in args.knee_rates.split(",") if r]
+        rep = knee_throughput(fn, rates, duration_s=args.duration,
+                              slo_p99_ms=slo)
+    elif args.mode == "closed":
         rep = run_closed_loop(fn, n_requests=args.requests,
                               concurrency=args.concurrency,
                               slo_p99_ms=slo)
     else:
         rep = run_open_loop(fn, rate_rps=args.rate,
                             duration_s=args.duration, slo_p99_ms=slo)
+    rep["mix"] = args.mix
     print(json.dumps(rep))
 
 
