@@ -897,6 +897,171 @@ def serving_phase() -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+# r22: the fleet-router drill — 2 HOST-ONLY replicas (numpy engines
+# through the real batcher/server machinery, LocalTransport, no
+# sockets) under the real Router: dispatch spread, per-request routing
+# overhead, a breaker trip-and-recover, a hedged dispatch, and the
+# drain-on-503 flip. Serial dispatch from the bench thread (the one
+# hedge timer is router.py's registered Timer), so every router_* fact
+# stays non-null in the degraded/outage record.
+ROUTER_BENCH_REQUESTS = 40
+
+
+def router_phase() -> dict:
+    import shutil
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        save_checkpoint,
+    )
+    from distributed_tensorflow_tpu.serving.batcher import DynamicBatcher
+    from distributed_tensorflow_tpu.serving.engine import InferenceEngine
+    from distributed_tensorflow_tpu.serving.replica import (
+        LocalTransport,
+        Replica,
+        TransportError,
+    )
+    from distributed_tensorflow_tpu.serving.router import Router
+    from distributed_tensorflow_tpu.serving.server import (
+        InferenceServer,
+        InProcessClient,
+        make_predict_runner,
+    )
+
+    class _Flaky:
+        """Transport wrapper that refuses until told otherwise — the
+        breaker drill's unreachable-replica stand-in."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.fail = False
+
+        def get(self, path):
+            if self.fail:
+                raise TransportError("bench: injected connect-fail")
+            return self.inner.get(path)
+
+        def post(self, path, obj):
+            if self.fail:
+                raise TransportError("bench: injected connect-fail")
+            return self.inner.post(path, obj)
+
+    d = tempfile.mkdtemp(prefix="bench-router-")
+    batchers = []
+    try:
+        rng = np.random.default_rng(0)
+        params = {"w": rng.standard_normal((64, 16)).astype(np.float32),
+                  "b": np.zeros(16, np.float32)}
+        save_checkpoint(d, {"params": params}, 10)
+        replicas, clients = [], []
+        for i in range(2):
+            engine = InferenceEngine(_ServeBenchModel(), d, jit=False,
+                                     params_template=params, max_batch=8)
+            batcher = DynamicBatcher(make_predict_runner(engine),
+                                     max_batch=8, max_delay_ms=1.0,
+                                     queue_depth=64,
+                                     name=f"bench-router-{i}")
+            batchers.append(batcher)
+            client = InProcessClient(predict_batcher=batcher)
+            srv = InferenceServer(engine, client, port=0)  # never started
+            clients.append(client)
+            replicas.append(
+                Replica(f"bench-r{i}",
+                        _Flaky(LocalTransport(srv)),
+                        breaker_fails=2, eject_s=0.05))
+        router = Router(replicas, retries=2, backoff_ms=2.0,
+                        min_healthy=1, seed=0)
+        x = rng.standard_normal(64).astype(np.float32).tolist()
+        payload = {"inputs": x}
+
+        # dispatch spread + routing overhead: routed (hedge off — the
+        # honest single-dispatch path) vs direct on the same population
+        t0 = _time.perf_counter()
+        for _ in range(ROUTER_BENCH_REQUESTS):
+            status, _body, _name = router.dispatch("/v1/predict",
+                                                   dict(payload))
+            assert status == 200, f"routed dispatch failed: {status}"
+        routed_s = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        for _ in range(ROUTER_BENCH_REQUESTS):
+            clients[0].predict_ex(x)
+        direct_s = _time.perf_counter() - t0
+        spread = [r.snapshot()["dispatches"] for r in replicas]
+        assert min(spread) > 0, f"one replica starved: {spread}"
+
+        # hedge drill: a second router over the SAME fleet with a
+        # hair-trigger budget — the timer fires mid-dispatch and the
+        # duplicate rides the other replica (serial from this thread;
+        # the timer is router.py's registered hedge Timer)
+        hedger = Router(replicas, retries=2, backoff_ms=2.0,
+                        hedge_ms=0.5, hedge_budget_pct=100.0,
+                        min_healthy=1, seed=0)
+        for _ in range(8):
+            status, _body, _name = hedger.dispatch("/v1/predict",
+                                                   dict(payload))
+            assert status == 200, f"hedged dispatch failed: {status}"
+
+        # breaker drill: replica 1 goes unreachable — retries absorb
+        # onto replica 0, consecutive failures eject, then the
+        # half-open probe heals it after the cooldown
+        replicas[1].transport.fail = True
+        for _ in range(6):
+            status, _body, _name = router.dispatch("/v1/predict",
+                                                   dict(payload))
+            assert status == 200, "retry must absorb the outage"
+        ejections = replicas[1].snapshot()["ejections"]
+        assert ejections >= 1, "breaker never tripped"
+        replicas[1].transport.fail = False
+        _time.sleep(0.08)  # past eject_s: the probe window opens
+        healed = False
+        for _ in range(20):
+            router.dispatch("/v1/predict", dict(payload))
+            if replicas[1].is_healthy():
+                healed = True
+                break
+        assert healed, "half-open probe never closed the breaker"
+
+        # drain-on-503 LAST (it closes a batcher): replica 1's healthz
+        # flips 503, the fold drains it, traffic keeps flowing on 0
+        batchers[1].close(drain=False)
+        st, body = replicas[1].transport.get("/healthz")
+        replicas[1].observe_health(st, body, _time.monotonic())
+        assert replicas[1].state_name() == "draining", \
+            replicas[1].state_name()
+        status, _body, name = router.dispatch("/v1/predict",
+                                              dict(payload))
+        assert status == 200 and name == "bench-r0", (status, name)
+
+        fleet = router.fleet_report()
+        n = ROUTER_BENCH_REQUESTS
+        return {
+            "router_replicas": len(replicas),
+            "router_healthy": fleet["healthy"],
+            "router_ejections": sum(r["ejections"]
+                                    for r in fleet["replicas"]),
+            "router_retries": fleet["retries_total"],
+            "router_hedges": hedger.fleet_report()["hedges_total"],
+            "router_overhead_ms": round(
+                max(routed_s - direct_s, 0.0) / n * 1e3, 4),
+        }
+    except Exception as e:  # never kill the record over the drill
+        return {"router_replicas": None,
+                "router_healthy": None,
+                "router_ejections": None,
+                "router_retries": None,
+                "router_hedges": None,
+                "router_overhead_ms": None,
+                "router_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        for b in batchers:
+            if not b.closed:
+                b.close(drain=False)
+        shutil.rmtree(d, ignore_errors=True)
+
+
 # r21: continuous batching — the long-generation-adversary A/B. Both
 # arms are HOST-ONLY (HostSlotBackend charges a fixed sleep per decode
 # iteration; no jax, no chip), so every continuous_*/kv_* field stays
@@ -2452,6 +2617,9 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
     # and its overhead_pct stays null here)
     out.update(recovery_phase())
     out.update(serving_phase())
+    # r22: the fleet-router drill is host-only too — router_* facts
+    # stay non-null in EVERY record incl. degraded/outage
+    out.update(router_phase())
     # r21: the continuous-batching page-ledger facts are analytic
     # (zero-step-cost drill) and stay non-null in outages; the knee
     # A/B is a wall-clock rate sweep and stays null here, like the
@@ -2592,6 +2760,9 @@ def _run_phases(out: dict):
     # r9: the serving drill (host-only for the same reason) — offered
     # load through the real engine/batcher/hot-reload machinery
     out.update(serving_phase())
+    # r22: the fleet-router drill (host-only 2-replica fleet) —
+    # dispatch spread, breaker trip/recover, hedge, drain-on-503
+    out.update(router_phase())
     # r21: continuous batching vs whole-batch on the long-tail mix
     # (host-only A/B at equal per-iteration cost) + page-ledger facts
     out.update(continuous_batching_phase())

@@ -7,7 +7,9 @@ assembly with deadline-aware admission), ``decode`` (KV-cache
 autoregressive decode, bitwise-consistent with full recompute),
 ``server`` (stdlib JSON-over-HTTP + in-process client), ``reqtrace``
 (the request plane: per-request phase timelines, tail attribution, SLO
-accounting). Run it:
+accounting), ``router``/``replica`` (the fleet front-end: health-driven
+power-of-two-choices dispatch with retries, hedging, circuit breaking,
+and rolling reload over N replicas). Run it:
 
     python -m distributed_tensorflow_tpu.serving --logdir /tmp/train_logs
 """
@@ -39,6 +41,18 @@ from distributed_tensorflow_tpu.serving.engine import (
     InferenceEngine,
     NoCheckpointError,
 )
+from distributed_tensorflow_tpu.serving.replica import (
+    HttpTransport,
+    LocalTransport,
+    Replica,
+    ReplicaState,
+    TransportError,
+)
+from distributed_tensorflow_tpu.serving.router import (
+    HealthPoller,
+    Router,
+    RouterServer,
+)
 from distributed_tensorflow_tpu.serving.server import (
     InferenceServer,
     InProcessClient,
@@ -56,16 +70,24 @@ __all__ = [
     "DynamicBatcher",
     "EngineSlotBackend",
     "Future",
+    "HealthPoller",
     "HostSlotBackend",
+    "HttpTransport",
     "InferenceEngine",
     "InferenceServer",
     "InProcessClient",
+    "LocalTransport",
     "NoCheckpointError",
     "PageAllocator",
     "RejectedError",
+    "Replica",
+    "ReplicaState",
     "RequestPlane",
+    "Router",
+    "RouterServer",
     "SLOLedger",
     "ServingMetrics",
+    "TransportError",
     "generate_group_key",
     "make_generate_runner",
     "make_predict_runner",

@@ -221,7 +221,8 @@ class InferenceEngine:
             xb = np.concatenate([x, pad], axis=0)
         else:
             xb = x
-        params, _ = self.current()
+        params, step = self.current()
+        reqtrace.note_served_step(step)
         fn = self._apply_fn()
         # request plane: the forward (staging + dispatch + the
         # device->host readback) is the predict route's "prefill"
@@ -268,7 +269,8 @@ class InferenceEngine:
                 fns = (dec.make_prefill(self.model, jit=self.jit),
                        dec.make_decode_step(self.model, jit=self.jit))
                 self._decode_cache["decode"] = fns
-        params, _ = self.current()
+        params, step = self.current()
+        reqtrace.note_served_step(step)
         rng = None
         if temperature > 0.0:
             import os

@@ -1,0 +1,363 @@
+"""The router's client-side replica model (r22): one object per engine
+replica holding the folded health picture, the circuit-breaker state
+machine, and the in-flight ledger the power-of-two-choices dispatcher
+weighs.
+
+State machine (``ReplicaState``):
+
+- **healthy** — dispatchable. The steady state.
+- **draining** — the replica answered its /healthz poll with 503 (HBM
+  floor, SLO fast-burn, closed batcher, KV-page floor — the replica's
+  own drain signals). No NEW dispatch; in-flight requests complete; the
+  next 200 poll flips it back. Drain is reversible and poll-driven —
+  the replica asked to be left alone, it did not disappear.
+- **ejected** — the circuit breaker tripped: ``breaker_fails``
+  consecutive dispatch/poll failures (connect-fail or 5xx result).
+  After ``eject_s`` (doubling per consecutive re-ejection, capped) the
+  replica becomes a HALF-OPEN probe target: exactly one trial request
+  may flow; success closes the breaker, failure re-ejects with a longer
+  cooldown. An unreachable replica therefore costs the fleet one probe
+  per cooldown, not a retry storm.
+
+Admin drain (``set_admin_drain``) is an orthogonal bit the rolling-
+reload orchestration sets: an admin-drained replica takes no new
+dispatch whatever its health state, so a checkpoint swap happens on a
+quiet engine.
+
+Locking: ``Replica._lock`` is a LEAF lock — every mutable field lives
+under it, no I/O and no other lock is ever acquired while holding it,
+and state-transition span emission happens from the returned transition
+tag AFTER release. Transports are stateless and lock-free.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+
+class TransportError(Exception):
+    """The replica could not be reached at all (connect refused, socket
+    reset, DNS): the retriable failure class, distinct from an HTTP
+    status the replica itself chose to send."""
+
+
+class HttpTransport:
+    """Stateless stdlib HTTP client for one replica base URL."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        if "://" not in self.base_url:
+            self.base_url = "http://" + self.base_url
+        self.timeout_s = float(timeout_s)
+
+    def _round_trip(self, req) -> tuple[int, dict]:
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return r.status, json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            # a status the replica chose (429/503/500...): report it
+            try:
+                body = json.loads(e.read().decode() or "{}")
+            except (ValueError, OSError):
+                body = {}
+            return e.code, body
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise TransportError(f"{self.base_url}: {e}") from e
+
+    def get(self, path: str) -> tuple[int, dict]:
+        return self._round_trip(urllib.request.Request(
+            self.base_url + path, method="GET"))
+
+    def post(self, path: str, obj: dict) -> tuple[int, dict]:
+        body = json.dumps(obj).encode()
+        return self._round_trip(urllib.request.Request(
+            self.base_url + path, data=body, method="POST",
+            headers={"Content-Type": "application/json"}))
+
+    def __repr__(self):
+        return f"HttpTransport({self.base_url})"
+
+
+class LocalTransport:
+    """In-process transport over an ``InferenceServer`` that was never
+    started: the same (status, body) surface ``_Handler`` puts on the
+    wire, without sockets — what bench's host-only ``router_phase`` and
+    the fast-tier tests dispatch through."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def get(self, path: str) -> tuple[int, dict]:
+        srv = self.server
+        if path == "/healthz":
+            health = srv.healthz()
+            return (200 if health["ok"] else 503), health
+        if path == "/metrics":
+            return 200, srv.metrics()
+        if path == "/stats":
+            return 200, srv.stats()
+        return 404, {"error": f"no route {path}"}
+
+    def post(self, path: str, obj: dict) -> tuple[int, dict]:
+        from distributed_tensorflow_tpu.serving.batcher import (
+            RejectedError,
+        )
+
+        srv = self.server
+        rid = obj.get("request_id")
+        try:
+            if path == "/v1/predict":
+                out, meta = srv.client.predict_ex(
+                    np.asarray(obj["inputs"]),
+                    timeout_ms=obj.get("timeout_ms"), request_id=rid)
+                return 200, {"outputs": np.asarray(out).tolist(), **meta}
+            if path == "/v1/generate":
+                toks, meta = srv.client.generate_ex(
+                    obj["prompt"],
+                    max_new_tokens=obj.get("max_new_tokens"),
+                    temperature=obj.get("temperature"),
+                    seed=obj.get("seed"),
+                    timeout_ms=obj.get("timeout_ms"), request_id=rid)
+                return 200, {"tokens": np.asarray(toks).tolist(), **meta}
+            if path == "/admin/reload":
+                report = srv.engine.reload_if_newer()
+                return 200, {"reloaded": report is not None,
+                             "report": report,
+                             "params_step": srv.engine.step}
+            return 404, {"error": f"no route {path}"}
+        except RejectedError as e:
+            return 429, {"error": e.reason, "rejected": True,
+                         "request_id": getattr(e, "request_id", None)
+                         or rid}
+        except (KeyError, ValueError) as e:
+            return 400, {"error": f"{type(e).__name__}: {e}",
+                         "request_id": rid}
+        except TimeoutError as e:
+            return 504, {"error": "request timed out in flight",
+                         "request_id": getattr(e, "request_id", None)
+                         or rid}
+        except Exception as e:  # noqa: BLE001 — mirror the wire handler
+            return 500, {"error": f"{type(e).__name__}: {e}",
+                         "request_id": rid}
+
+    def __repr__(self):
+        return f"LocalTransport({self.server.address})"
+
+
+class ReplicaState:
+    HEALTHY = "healthy"
+    DRAINING = "draining"
+    EJECTED = "ejected"
+
+
+EJECT_BACKOFF_CAP = 8  # max cooldown multiplier: eject_s * 2**(n-1) <= *8
+
+
+class Replica:
+    """One replica's router-side ledger. All mutation under the leaf
+    ``_lock``; methods that change state return a transition tag (or
+    None) so the caller emits spans/flight records OUTSIDE the lock."""
+
+    def __init__(self, name: str, transport, *,
+                 breaker_fails: int = 3, eject_s: float = 1.0):
+        self.name = name
+        self.transport = transport
+        self.breaker_fails = max(int(breaker_fails), 1)
+        self.eject_s = float(eject_s)
+        self._lock = threading.Lock()
+        self.state = ReplicaState.HEALTHY
+        self.health: dict = {}     # last /healthz body
+        self.signals: dict = {}    # folded /metrics signals
+        self.inflight = 0
+        self.consecutive_failures = 0
+        self.ejected_until = 0.0
+        self._eject_streak = 0     # consecutive ejections -> backoff
+        self.probe_inflight = False
+        self.admin_drain = False
+        self.last_served_step = None
+        self.dispatches = 0
+        self.failures = 0
+        self.ejections = 0
+
+    # ------------------------------------------------------ health fold
+
+    def observe_health(self, status: int | None, body: dict | None,
+                       now: float, *, metrics: dict | None = None,
+                       error: str | None = None) -> str | None:
+        """Fold one poll result. ``status=None`` + ``error`` means the
+        poll itself failed to connect — breaker-feeding evidence, same
+        as a dispatch connect-fail."""
+        with self._lock:
+            if metrics is not None:
+                hbm = metrics.get("hbm") or {}
+                self.signals = {
+                    "params_step": metrics.get("params_step"),
+                    "goodput_uptime_pct": metrics.get(
+                        "goodput_uptime_pct"),
+                    "hbm_headroom_pct": hbm.get("headroom_pct"),
+                    "kv_pages": hbm.get("kv_pages"),
+                    "slo": metrics.get("slo"),
+                    "p99_trend": {
+                        route: (metrics.get(route) or {}).get(
+                            "health", {}).get("p99_trend")
+                        for route in ("predict", "generate")
+                        if route in metrics},
+                }
+            if status is None:
+                self.health = {"ok": False, "error": error}
+                return self._note_failure_locked(now)
+            self.health = dict(body or {})
+            if status == 200 and body and body.get("ok"):
+                self.consecutive_failures = 0
+                if self.state == ReplicaState.DRAINING:
+                    self.state = ReplicaState.HEALTHY
+                    return "undrain"
+                if self.state == ReplicaState.HEALTHY:
+                    self._eject_streak = 0
+                # ejected replicas heal through the half-open dispatch
+                # probe, not the poll: a 200 /healthz proves the socket,
+                # the probe proves the serving path
+                return None
+            # 503 (or malformed body): the replica asked to drain
+            if self.state == ReplicaState.HEALTHY:
+                self.state = ReplicaState.DRAINING
+                return "drain"
+            return None
+
+    # -------------------------------------------------- breaker surface
+
+    def _note_failure_locked(self, now: float) -> str | None:
+        self.consecutive_failures += 1
+        self.failures += 1
+        if self.state == ReplicaState.EJECTED:
+            # a failed half-open probe: re-eject with a longer cooldown
+            if now >= self.ejected_until:
+                return self._eject_locked(now)
+            return None
+        if self.consecutive_failures >= self.breaker_fails:
+            return self._eject_locked(now)
+        return None
+
+    def _eject_locked(self, now: float) -> str:
+        self.state = ReplicaState.EJECTED
+        self._eject_streak += 1
+        mult = min(2 ** (self._eject_streak - 1), EJECT_BACKOFF_CAP)
+        self.ejected_until = now + self.eject_s * mult
+        self.probe_inflight = False
+        self.ejections += 1
+        return "eject"
+
+    def note_failure(self, now: float) -> str | None:
+        """A dispatch attempt failed (connect-fail or 5xx)."""
+        with self._lock:
+            return self._note_failure_locked(now)
+
+    def note_success(self) -> str | None:
+        """A dispatch attempt succeeded (any status the replica chose
+        below 500 — a 429 replica is alive and judging)."""
+        with self._lock:
+            self.consecutive_failures = 0
+            if self.state == ReplicaState.EJECTED:
+                # the half-open probe came back: close the breaker
+                self.state = ReplicaState.HEALTHY
+                self._eject_streak = 0
+                return "heal"
+            return None
+
+    # ------------------------------------------------- dispatch surface
+
+    def dispatchable(self, now: float) -> bool:
+        with self._lock:
+            return self._dispatchable_locked(now)
+
+    def _dispatchable_locked(self, now: float) -> bool:
+        if self.admin_drain:
+            return False
+        if self.state == ReplicaState.HEALTHY:
+            return True
+        if self.state == ReplicaState.EJECTED:
+            # half-open trickle: one probe past the cooldown
+            return now >= self.ejected_until and not self.probe_inflight
+        return False  # draining
+
+    def begin_dispatch(self, now: float) -> bool:
+        """Claim a dispatch slot (and, half-open, THE probe slot).
+        False when the replica stopped being dispatchable since it was
+        picked — the dispatcher just picks again."""
+        with self._lock:
+            if not self._dispatchable_locked(now):
+                return False
+            if self.state == ReplicaState.EJECTED:
+                self.probe_inflight = True
+            self.inflight += 1
+            self.dispatches += 1
+            return True
+
+    def end_dispatch(self, ok: bool, now: float,
+                     served_step=None) -> str | None:
+        with self._lock:
+            self.inflight = max(self.inflight - 1, 0)
+            self.probe_inflight = False
+            if served_step is not None:
+                self.last_served_step = served_step
+        return self.note_success() if ok else self.note_failure(now)
+
+    def load(self) -> float:
+        """The p2c weight: requests the router has in flight here plus
+        the replica's own last-polled queue depth."""
+        with self._lock:
+            depth = self.health.get("queue_depth") or 0
+            return self.inflight + float(depth)
+
+    def set_admin_drain(self, on: bool) -> None:
+        with self._lock:
+            self.admin_drain = bool(on)
+
+    def state_name(self) -> str:
+        with self._lock:
+            return self.state
+
+    def is_healthy(self) -> bool:
+        """Healthy AND serving (not admin-drained) — the router's
+        min-healthy accounting unit."""
+        with self._lock:
+            return (self.state == ReplicaState.HEALTHY
+                    and not self.admin_drain)
+
+    def inflight_count(self) -> int:
+        with self._lock:
+            return self.inflight
+
+    def snapshot(self, now: float | None = None) -> dict:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return {
+                "name": self.name,
+                "state": self.state,
+                "admin_drain": self.admin_drain,
+                "dispatchable": self._dispatchable_locked(now),
+                "inflight": self.inflight,
+                "queue_depth": self.health.get("queue_depth"),
+                "params_step": self.health.get("params_step",
+                                               self.signals.get(
+                                                   "params_step")),
+                "last_served_step": self.last_served_step,
+                "consecutive_failures": self.consecutive_failures,
+                "dispatches": self.dispatches,
+                "failures": self.failures,
+                "ejections": self.ejections,
+                "eject_cooldown_s": (
+                    round(max(self.ejected_until - now, 0.0), 3)
+                    if self.state == ReplicaState.EJECTED else 0.0),
+                "slo_fast_burn": self.health.get("slo_fast_burn"),
+                "hbm_headroom_pct": self.health.get("hbm_headroom_pct"),
+                "goodput_uptime_pct": self.signals.get(
+                    "goodput_uptime_pct"),
+                "p99_trend": self.signals.get("p99_trend"),
+            }

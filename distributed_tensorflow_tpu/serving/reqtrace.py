@@ -113,7 +113,7 @@ class RequestTrace:
     __slots__ = ("plane", "request_id", "route", "bucket", "wall0", "t0",
                  "t_admitted", "t_taken", "t_run0", "t_run1", "noted",
                  "decode_ticks", "summary", "slot", "iter_admit",
-                 "iter_retire")
+                 "iter_retire", "served_step")
 
     def __init__(self, plane, request_id: str, route: str, bucket: int):
         self.plane = plane
@@ -135,6 +135,10 @@ class RequestTrace:
         self.slot = None
         self.iter_admit = None
         self.iter_retire = None
+        # fleet router (r22): the checkpoint step of the params snapshot
+        # that served this request — the per-replica monotonicity fact
+        # the rolling-reload test pins
+        self.served_step = None
 
     def admitted(self) -> None:
         self.t_admitted = time.monotonic()
@@ -288,10 +292,13 @@ class RequestPlane:
     for tail attribution, the optional SLO ledger, and the ``req:*``
     span emission into the telemetry spine."""
 
+    SLO_SEEN_CAP = 65536
+
     def __init__(self, ring: int = RING_DEFAULT,
                  exemplars: int = EXEMPLARS_DEFAULT,
                  slo_p99_ms: float = 0.0,
-                 slo_target_pct: float = 99.0):
+                 slo_target_pct: float = 99.0,
+                 dedupe_window_s: float = 120.0):
         self.audit: deque = deque(maxlen=max(int(ring), 1))
         self.exemplars = max(int(exemplars), 1)
         self.slo = (SLOLedger(slo_p99_ms, slo_target_pct)
@@ -300,6 +307,16 @@ class RequestPlane:
         self._hists: dict = {}  # (route, bucket) -> {phase|"total": hist}
         self.requests_total = 0
         self.by_disposition = dict.fromkeys(DISPOSITIONS, 0)
+        # r22 bugfix: a client/router retry reuses its request_id, and
+        # each attempt's finish() used to book an SLO outcome — a hedged
+        # or retried request burned the error budget twice. Terminal
+        # dispositions now dedupe by id within a window: the FIRST
+        # finish for an id books; later finishes for the same id within
+        # ``dedupe_window_s`` count only in ``slo_deduped``. Insertion-
+        # ordered dict, evicted from the front by age and a hard cap.
+        self.dedupe_window_s = float(dedupe_window_s)
+        self._slo_seen: dict = {}  # request_id -> mono_t of first book
+        self.slo_deduped = 0
 
     # ------------------------------------------------------- lifecycle
 
@@ -336,6 +353,8 @@ class RequestPlane:
             summary["slot"] = tr.slot
             summary["iter_admit"] = tr.iter_admit
             summary["iter_retire"] = tr.iter_retire
+        if tr.served_step is not None:
+            summary["served_step"] = tr.served_step
         tr.summary = summary
         ok = disposition == "ok"
         with self._lock:
@@ -352,10 +371,31 @@ class RequestPlane:
             if th is None:
                 th = hists["total"] = StreamingHistogram()
             th.record(total_s * 1e3)
-        if self.slo is not None:
+            first_outcome = self._slo_first_outcome(tr.request_id, now)
+        if self.slo is not None and first_outcome:
             self.slo.observe(total_s * 1e3, ok)
         self._emit(tr, summary, phases)
         return summary
+
+    def _slo_first_outcome(self, request_id: str, now: float) -> bool:
+        """Under ``self._lock``: True iff this id has NOT booked an SLO
+        outcome within the dedupe window (and record that it now has).
+        Front-evicts expired/overflow ids — the dict is insertion-
+        ordered, so the oldest entries are always first."""
+        seen = self._slo_seen
+        cutoff = now - self.dedupe_window_s
+        while seen:
+            rid, t = next(iter(seen.items()))
+            if t >= cutoff and len(seen) < self.SLO_SEEN_CAP:
+                break
+            del seen[rid]
+        prior = seen.get(request_id)
+        if prior is not None and prior >= cutoff:
+            self.slo_deduped += 1
+            return False
+        seen.pop(request_id, None)  # re-insert at the back if expired
+        seen[request_id] = now
+        return True
 
     def _emit(self, tr: RequestTrace, summary: dict,
               phases: dict) -> None:
@@ -496,6 +536,20 @@ def note_phase(phase: str, dur_s: float, ticks: int | None = None) -> None:
     ``batch_context`` (direct engine calls, tests)."""
     for t in getattr(_CTX, "traces", ()):
         t.note(phase, dur_s, ticks)
+
+
+def note_served_step(step) -> None:
+    """Fleet router (r22): stamp the checkpoint step of the params
+    snapshot serving the current microbatch on every request in it.
+    The engine reads ``(params, step)`` ONCE per microbatch under its
+    swap lock, so every request in a batch shares one step — the
+    "never a mixed-step batch" fact the rolling-reload test pins rides
+    this stamp into the summary and the wire meta. No-op outside a
+    ``batch_context``."""
+    if step is None:
+        return
+    for t in getattr(_CTX, "traces", ()):
+        t.served_step = int(step)
 
 
 def note_slot_admit(tr, iteration: int, slot: int) -> None:

@@ -1,0 +1,632 @@
+"""The fleet router (r22): a health-driven stdlib HTTP front-end that
+fans traffic over N engine replicas — ROADMAP item 2's multi-replica
+layer.
+
+- **Health poller.** A background thread folds each replica's
+  ``/healthz`` (ok, queue depth, HBM floor, KV pages, SLO fast-burn)
+  and every k-th tick its ``/metrics`` (goodput, p99 trend, burn
+  rates) into the replica's ``ReplicaState`` (serving/replica.py): a
+  503 DRAINS the replica (in-flight completes, no new dispatch — the
+  replica asked), a connect-fail feeds the circuit breaker, a 200
+  heals a drain. State transitions emit ``route_state`` instants and
+  flight-recorder records, so an ejection is NAMED in the postmortem
+  ring.
+- **Dispatch.** Power-of-two-choices least-loaded over dispatchable
+  replicas (load = router in-flight + replica-reported queue depth);
+  bounded per-request retries on connect-fail/5xx with exponential
+  backoff + jitter, capped by a global retry budget (a percentage of
+  observed requests, with a small burst floor — retry storms cannot
+  amplify an outage). 4xx/429 pass through untouched: the replica
+  answered; the answer is the client's problem.
+- **Hedging.** With ``--router_hedge_ms`` set, a request still
+  unresolved at the budget fires ONE duplicate onto a different
+  replica (its own budget caps the volume). First success wins; the
+  loser's result is discarded at the race, and the replica-side SLO
+  ledger books exactly one outcome per request id
+  (serving/reqtrace.py's r22 dedupe).
+- **Rolling reload.** ``rolling_reload()`` walks the fleet one
+  replica at a time: admin-drain, wait for in-flight zero, POST
+  ``/admin/reload``, wait healthy, undrain — a fleet-wide checkpoint
+  swap that never drops the healthy count below
+  ``--router_min_healthy`` and never serves a mixed-step batch from
+  one replica (the engine swaps between microbatches; the wire's
+  ``served_step`` meta proves it per response).
+
+Lock order (dttsan-registered): ``Router._lock`` (budget counters) and
+``_Race._lock`` (per-request race state) are both LEAF locks, as is
+``Replica._lock`` — no path holds two of them at once, and no I/O or
+sleep happens under any of them. The poller thread, the hedge timer,
+and the HTTP handler threads meet only through those leaf locks.
+
+Fault points: ``router_dispatch`` (before each attempt),
+``router_health`` (before each poll), ``router_hedge`` (before the
+duplicate launches) — utils/faults.py one-liners stand in for killed
+replicas, flaky networks, and hedge storms.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from distributed_tensorflow_tpu.serving import reqtrace
+from distributed_tensorflow_tpu.serving.replica import (
+    HttpTransport,
+    Replica,
+    TransportError,
+)
+from distributed_tensorflow_tpu.utils import telemetry
+from distributed_tensorflow_tpu.utils.faults import (
+    InjectedFault,
+    fault_point,
+)
+from distributed_tensorflow_tpu.utils.telemetry import trace_span
+
+ROUTES = ("/v1/predict", "/v1/generate")
+RETRY_BURST_FLOOR = 3  # retries allowed before the pct budget has data
+HEDGE_BURST_FLOOR = 1
+
+
+def _emit_transition(replica: Replica, transition: str | None,
+                     **attrs) -> None:
+    """A replica state transition as a ``route_state`` instant plus a
+    flight-recorder record — called AFTER the replica lock released
+    (the transition tag is the handoff)."""
+    if transition is None:
+        return
+    state = replica.state_name()
+    telemetry.get_tracer().record_instant(
+        "route_state", replica=replica.name, transition=transition,
+        state=state, **attrs)
+    telemetry.flight_recorder().record(
+        "router", {"replica": replica.name, "transition": transition,
+                   "state": state, **attrs})
+
+
+class HealthPoller:
+    """One daemon thread polling every replica's /healthz (and every
+    ``metrics_every``-th tick /metrics) on a fixed cadence. The
+    stop/start handoff mirrors CheckpointWatcher: each ``start()``
+    hands its thread a FRESH stop event (dttsan SAN004's restartable-
+    start pattern), and ``poll_once()`` runs one synchronous sweep for
+    tests and the bench."""
+
+    def __init__(self, replicas, interval_s: float = 0.2,
+                 metrics_every: int = 5):
+        self.replicas = list(replicas)
+        self.interval_s = float(interval_s)
+        self.metrics_every = max(int(metrics_every), 1)
+        self._lock = threading.Lock()       # thread lifecycle only
+        self._tick_lock = threading.Lock()  # leaf: the sweep counter
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._tick = 0
+
+    def start(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop = threading.Event()
+                self._thread = threading.Thread(
+                    target=self._loop, args=(self._stop,),
+                    name="router-health-poller", daemon=True)
+                self._thread.start()
+        return self
+
+    def poll_once(self) -> None:
+        """One synchronous sweep over the fleet. All I/O lock-free; the
+        fold happens in ``Replica.observe_health`` under its leaf
+        lock."""
+        with self._tick_lock:
+            self._tick += 1
+            tick = self._tick
+        want_metrics = tick % self.metrics_every == 0
+        for rep in self.replicas:
+            now = time.monotonic()
+            try:
+                fault_point("router_health", replica=rep.name,
+                            count=tick)
+                status, body = rep.transport.get("/healthz")
+                metrics = None
+                if want_metrics:
+                    mstatus, mbody = rep.transport.get("/metrics")
+                    if mstatus == 200:
+                        metrics = mbody
+            except (TransportError, InjectedFault) as e:
+                transition = rep.observe_health(None, None, now,
+                                                error=str(e))
+            else:
+                transition = rep.observe_health(status, body, now,
+                                                metrics=metrics)
+            _emit_transition(rep, transition, source="poll")
+
+    def _loop(self, stop: threading.Event):
+        # the event is an ARGUMENT, not read off self: a restart points
+        # self._stop at a fresh event for the new thread
+        while not stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception as e:  # the poller must outlive bad ticks
+                print(f"router health poll failed: {e}")
+
+    def close(self):
+        with self._lock:
+            self._stop.set()
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10)
+
+
+class _Race:
+    """Per-request race between the primary arm and an optional hedge:
+    first SUCCESS wins; failure is declared only when every joined arm
+    has exhausted its retries. All fields under the leaf ``_lock``;
+    waiters block on the event, never the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ev = threading.Event()
+        self._pending = 1  # the primary; a fired hedge joins
+        self._result = None
+        self._failure = None
+        self._winner = None
+        self._primary_replica = None
+
+    def try_join(self) -> bool:
+        with self._lock:
+            if self._ev.is_set():
+                return False  # already resolved: the hedge stays home
+            self._pending += 1
+            return True
+
+    def note_primary(self, name: str) -> None:
+        with self._lock:
+            self._primary_replica = name
+
+    def primary_replica(self) -> str | None:
+        with self._lock:
+            return self._primary_replica
+
+    def offer(self, arm: str, ok: bool, value) -> None:
+        with self._lock:
+            self._pending -= 1
+            if ok and self._result is None:
+                self._result = value
+                self._winner = arm
+                self._ev.set()
+            elif not ok:
+                self._failure = value
+                if self._pending <= 0 and self._result is None:
+                    self._ev.set()
+
+    def wait(self, timeout_s: float):
+        """(status, body, replica_name, winner_arm) — the winner, or
+        the last failure when every arm lost."""
+        self._ev.wait(timeout_s)
+        with self._lock:
+            if self._result is not None:
+                return (*self._result, self._winner)
+            if self._failure is not None:
+                return (*self._failure, None)
+            return (504, {"error": "router race unresolved"}, None, None)
+
+
+class Router:
+    """The dispatch core: p2c pick, retry/hedge budgets, per-request
+    races. Owns no sockets — ``RouterServer`` puts it on the wire and
+    bench/tests drive it directly."""
+
+    def __init__(self, replicas, *, retries: int = 2,
+                 backoff_ms: float = 20.0, retry_budget_pct: float = 10.0,
+                 hedge_ms: float = 0.0, hedge_budget_pct: float = 5.0,
+                 min_healthy: int = 1, arm_timeout_s: float = 60.0,
+                 seed: int | None = None):
+        self.replicas = list(replicas)
+        self.retries = max(int(retries), 0)
+        self.backoff_s = max(float(backoff_ms), 0.0) / 1e3
+        self.retry_budget_pct = float(retry_budget_pct)
+        self.hedge_s = max(float(hedge_ms), 0.0) / 1e3
+        self.hedge_budget_pct = float(hedge_budget_pct)
+        self.min_healthy = max(int(min_healthy), 0)
+        self.arm_timeout_s = float(arm_timeout_s)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.retries_total = 0
+        self.retries_denied = 0
+        self.hedges_total = 0
+        self.hedges_denied = 0
+        self.hedge_wins = 0
+        self.no_replica_total = 0
+
+    # ------------------------------------------------------------ picks
+
+    def _pick(self, now: float, exclude=()) -> Replica | None:
+        """Power-of-two-choices: two distinct random dispatchable
+        candidates, take the less loaded (one candidate: take it)."""
+        avail = [r for r in self.replicas
+                 if r.name not in exclude and r.dispatchable(now)]
+        if not avail:
+            return None
+        if len(avail) == 1:
+            return avail[0]
+        a, b = self._rng.sample(avail, 2)
+        return a if a.load() <= b.load() else b
+
+    def healthy_count(self) -> int:
+        return sum(1 for r in self.replicas if r.is_healthy())
+
+    # --------------------------------------------------------- budgets
+
+    def _consume_retry(self) -> bool:
+        with self._lock:
+            cap = (self.retry_budget_pct / 100.0
+                   * max(self.requests_total, 1) + RETRY_BURST_FLOOR)
+            if self.retries_total < cap:
+                self.retries_total += 1
+                return True
+            self.retries_denied += 1
+            return False
+
+    def _consume_hedge(self) -> bool:
+        with self._lock:
+            cap = (self.hedge_budget_pct / 100.0
+                   * max(self.requests_total, 1) + HEDGE_BURST_FLOOR)
+            if self.hedges_total < cap:
+                self.hedges_total += 1
+                return True
+            self.hedges_denied += 1
+            return False
+
+    # -------------------------------------------------------- dispatch
+
+    def dispatch(self, route: str, payload: dict,
+                 request_id: str | None = None):
+        """(status, body, replica_name) — the one front-door. Mints or
+        echoes the request id, runs the primary arm in the calling
+        thread, arms the hedge timer when configured, and resolves the
+        race."""
+        rid = (request_id or payload.get("request_id")
+               or reqtrace.new_request_id())
+        payload = {**payload, "request_id": rid}
+        with self._lock:
+            self.requests_total += 1
+        race = _Race()
+        timer = None
+        with trace_span("route_dispatch", request_id=rid, route=route):
+            if self.hedge_s > 0:
+                timer = threading.Timer(
+                    self.hedge_s, self._fire_hedge,
+                    args=(race, route, payload, rid))
+                timer.daemon = True
+                timer.start()
+            self._run_arm(race, "primary", route, payload, rid)
+            if timer is not None:
+                # no-op if the hedge already fired — then the race's
+                # pending count keeps us honest below
+                timer.cancel()
+            status, body, name, winner = race.wait(self.arm_timeout_s)
+            if winner == "hedge":
+                with self._lock:
+                    self.hedge_wins += 1
+        body = dict(body or {})
+        body.setdefault("request_id", rid)
+        return status, body, name
+
+    def _run_arm(self, race: _Race, arm: str, route: str,
+                 payload: dict, rid: str) -> None:
+        """One arm of the race: pick → dispatch → retry until success,
+        retries exhausted, or the budget says no. Runs in the caller
+        thread (primary) or the hedge timer's thread. Never holds a
+        lock across I/O or sleep."""
+        exclude = ()
+        if arm == "hedge":
+            primary = race.primary_replica()
+            exclude = (primary,) if primary else ()
+        attempt = 0
+        last = (503, {"error": "no dispatchable replica",
+                      "request_id": rid})
+        while True:
+            now = time.monotonic()
+            rep = self._pick(now, exclude)
+            if rep is None or not rep.begin_dispatch(now):
+                with self._lock:
+                    self.no_replica_total += 1
+            else:
+                if arm == "primary":
+                    race.note_primary(rep.name)
+                try:
+                    fault_point("router_dispatch", replica=rep.name,
+                                count=attempt)
+                    status, body = rep.transport.post(route, payload)
+                except (TransportError, InjectedFault) as e:
+                    transition = rep.end_dispatch(
+                        False, time.monotonic())
+                    _emit_transition(rep, transition, source="dispatch",
+                                     request_id=rid)
+                    last = (503, {"error": f"connect: {e}",
+                                  "request_id": rid})
+                else:
+                    ok = status < 500
+                    step = (body or {}).get("served_step")
+                    transition = rep.end_dispatch(
+                        ok, time.monotonic(), served_step=step)
+                    _emit_transition(rep, transition, source="dispatch",
+                                     request_id=rid)
+                    if ok:
+                        race.offer(arm, True, (status, body, rep.name))
+                        return
+                    last = (status, body)
+            attempt += 1
+            if attempt > self.retries or not self._consume_retry():
+                race.offer(arm, False, (*last, None))
+                return
+            telemetry.get_tracer().record_instant(
+                "route_retry", request_id=rid, arm=arm,
+                attempt=attempt, route=route)
+            # full jitter on an exponential base — no locks held
+            delay = (self.backoff_s * (2 ** (attempt - 1))
+                     * self._rng.uniform(0.5, 1.0))
+            if delay > 0:
+                time.sleep(delay)
+
+    def _fire_hedge(self, race: _Race, route: str, payload: dict,
+                    rid: str) -> None:
+        """The hedge timer's body: budget check, race join, duplicate
+        dispatch on a replica OTHER than the primary's. Runs entirely
+        in the timer thread."""
+        if not self._consume_hedge():
+            return
+        if not race.try_join():
+            return  # the primary already resolved the race
+        try:
+            fault_point("router_hedge", request_id=rid, count=1)
+        except InjectedFault as e:
+            race.offer("hedge", False,
+                       (503, {"error": f"hedge fault: {e}",
+                              "request_id": rid}, None))
+            return
+        telemetry.get_tracer().record_instant(
+            "route_hedge", request_id=rid, route=route)
+        self._run_arm(race, "hedge", route, payload, rid)
+
+    # -------------------------------------------------- fleet surfaces
+
+    def fleet_report(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            counters = {
+                "requests_total": self.requests_total,
+                "retries_total": self.retries_total,
+                "retries_denied": self.retries_denied,
+                "hedges_total": self.hedges_total,
+                "hedges_denied": self.hedges_denied,
+                "hedge_wins": self.hedge_wins,
+                "no_replica_total": self.no_replica_total,
+            }
+        return {
+            "replicas": [r.snapshot(now) for r in self.replicas],
+            "healthy": self.healthy_count(),
+            "min_healthy": self.min_healthy,
+            "hedge_ms": self.hedge_s * 1e3,
+            "retries": self.retries,
+            **counters,
+        }
+
+    def rolling_reload(self, poller: HealthPoller | None = None,
+                       timeout_s: float = 30.0,
+                       settle_s: float = 0.02) -> dict:
+        """Walk the fleet one replica at a time: drain → quiesce →
+        ``/admin/reload`` → healthy → undrain. The healthy count never
+        drops below ``min_healthy`` (the gate WAITS before draining),
+        and each replica swaps params between microbatches — no mixed-
+        step batch, per the engine's swap lock. Returns the per-replica
+        reload story plus ``min_healthy_observed`` for the invariant
+        test."""
+        deadline = time.monotonic() + float(timeout_s)
+        report = {"replicas": [], "min_healthy_observed": None,
+                  "ok": True}
+        lows = []
+
+        def _observe():
+            n = self.healthy_count()
+            lows.append(n)
+            return n
+
+        for rep in self.replicas:
+            entry = {"name": rep.name, "reloaded": False}
+            # gate: the REST of the fleet must hold min_healthy before
+            # this replica leaves it
+            while time.monotonic() < deadline:
+                others = sum(1 for r in self.replicas
+                             if r is not rep and r.is_healthy())
+                if others >= self.min_healthy:
+                    break
+                if poller is not None:
+                    poller.poll_once()
+                time.sleep(settle_s)
+            rep.set_admin_drain(True)
+            _observe()
+            while (rep.inflight_count() > 0
+                   and time.monotonic() < deadline):
+                time.sleep(settle_s)
+            try:
+                status, body = rep.transport.post("/admin/reload", {})
+                entry["reloaded"] = bool(
+                    status == 200 and body.get("reloaded"))
+                entry["params_step"] = (body or {}).get("params_step")
+            except TransportError as e:
+                entry["error"] = str(e)
+                report["ok"] = False
+            # wait for the replica to answer healthy before undraining
+            while time.monotonic() < deadline:
+                try:
+                    status, body = rep.transport.get("/healthz")
+                except TransportError:
+                    status, body = None, None
+                if status == 200 and body and body.get("ok"):
+                    transition = rep.observe_health(
+                        status, body, time.monotonic())
+                    _emit_transition(rep, transition, source="reload")
+                    break
+                time.sleep(settle_s)
+            rep.set_admin_drain(False)
+            _observe()
+            telemetry.get_tracer().record_instant(
+                "route_state", replica=rep.name, transition="reload",
+                state=rep.state_name(),
+                **{k: v for k, v in entry.items() if k != "name"})
+            report["replicas"].append(entry)
+        report["min_healthy_observed"] = min(lows) if lows else None
+        if (report["min_healthy_observed"] is not None
+                and report["min_healthy_observed"] < self.min_healthy):
+            report["ok"] = False
+        return report
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    server_version = "dtt-router/1.0"
+
+    def _send(self, code: int, obj: dict,
+              replica: str | None = None) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if replica is not None:
+            # per-replica attribution: loadgen's --targets report
+            # columns key on this header
+            self.send_header("X-DTT-Replica", replica)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # quiet: /metrics carries it
+        pass
+
+    def do_GET(self):
+        rs: RouterServer = self.server.routing  # type: ignore[attr-defined]
+        if self.path == "/healthz":
+            fleet = rs.router.fleet_report()
+            ok = fleet["healthy"] >= rs.router.min_healthy
+            self._send(200 if ok else 503, {"ok": ok, **fleet})
+        elif self.path == "/metrics":
+            self._send(200, rs.router.fleet_report())
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        rs: RouterServer = self.server.routing  # type: ignore[attr-defined]
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n) or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"bad JSON: {e}"})
+            return
+        if self.path in ROUTES:
+            if not isinstance(req, dict):
+                self._send(400, {"error": "body must be a JSON object"})
+                return
+            status, body, name = rs.router.dispatch(self.path, req)
+            self._send(status, body, replica=name or "none")
+        elif self.path == "/admin/rolling_reload":
+            self._send(200, rs.router.rolling_reload(rs.poller))
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+
+class RouterServer:
+    """ThreadingHTTPServer wrapper owning the router + poller pair."""
+
+    def __init__(self, router: Router, poller: HealthPoller,
+                 host: str = "127.0.0.1", port: int = 8100):
+        self.router = router
+        self.poller = poller
+        self.httpd = ThreadingHTTPServer((host, port), _RouterHandler)
+        self.httpd.routing = self  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        h, p = self.httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def start_background(self):
+        self.poller.start()
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="router-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self.poller.start()
+        self.httpd.serve_forever()
+
+    def close(self):
+        self.poller.close()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+def build_router_from_flags(FLAGS) -> tuple[Router, HealthPoller]:
+    """The one flag->feature mapping for ``--router_*``: replicas from
+    the comma-separated ``--router_replicas`` host:port list, budgets
+    and breaker knobs from their flags."""
+    targets = [t.strip() for t in
+               (getattr(FLAGS, "router_replicas", "") or "").split(",")
+               if t.strip()]
+    replicas = [
+        Replica(t, HttpTransport(t),
+                breaker_fails=int(getattr(FLAGS, "router_breaker_fails",
+                                          3)),
+                eject_s=float(getattr(FLAGS, "router_eject_s", 1.0)))
+        for t in targets]
+    router = Router(
+        replicas,
+        retries=int(getattr(FLAGS, "router_retries", 2)),
+        backoff_ms=float(getattr(FLAGS, "router_backoff_ms", 20.0)),
+        retry_budget_pct=float(getattr(FLAGS, "router_retry_budget_pct",
+                                       10.0)),
+        hedge_ms=float(getattr(FLAGS, "router_hedge_ms", 0.0)),
+        hedge_budget_pct=float(getattr(FLAGS, "router_hedge_budget_pct",
+                                       5.0)),
+        min_healthy=int(getattr(FLAGS, "router_min_healthy", 1)))
+    poller = HealthPoller(
+        replicas,
+        interval_s=float(getattr(FLAGS, "router_poll_ms", 200.0)) / 1e3)
+    return router, poller
+
+
+def main(argv=None) -> None:
+    import sys
+
+    from distributed_tensorflow_tpu import flags as flags_mod
+
+    flags_mod.define_serving_flags()
+    FLAGS = flags_mod.FLAGS
+    FLAGS._parse(sys.argv[1:] if argv is None else list(argv))
+    if not (getattr(FLAGS, "router_replicas", "") or "").strip():
+        raise SystemExit(
+            "--router_replicas host:port,... is required")
+    router, poller = build_router_from_flags(FLAGS)
+    server = RouterServer(router, poller,
+                          host=getattr(FLAGS, "router_host",
+                                       "127.0.0.1"),
+                          port=int(getattr(FLAGS, "router_port", 8100)))
+    # the parseable line harnesses wait for (same contract as serving)
+    print(f"routing on {server.address} over "
+          f"{len(router.replicas)} replicas", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

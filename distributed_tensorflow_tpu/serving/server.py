@@ -66,6 +66,11 @@ def _future_meta(fut) -> dict:
         meta["phases_ms"] = fut.meta["phases_ms"]
         meta["total_ms"] = fut.meta["total_ms"]
         meta["bucket"] = fut.meta["bucket"]
+        # fleet router (r22): which checkpoint step served the request
+        # — the router's rolling-reload test pins per-replica
+        # monotonicity of this field
+        if "served_step" in fut.meta:
+            meta["served_step"] = fut.meta["served_step"]
     return meta
 
 
@@ -345,6 +350,16 @@ class _Handler(BaseHTTPRequestHandler):
                     request_id=rid)
                 self._send(200, {"tokens": np.asarray(toks).tolist(),
                                  **meta})
+            elif self.path == "/admin/reload":
+                # fleet router (r22): the rolling-reload orchestration
+                # asks each drained replica to pick up a newer
+                # checkpoint NOW instead of waiting for its watcher
+                # tick. Safe under traffic (engine.reload_if_newer is
+                # serialized and swaps atomically between microbatches).
+                report = srv.engine.reload_if_newer()
+                self._send(200, {"reloaded": report is not None,
+                                 "report": report,
+                                 "params_step": srv.engine.step})
             else:
                 self._send(404, {"error": f"no route {self.path}"})
         except RejectedError as e:

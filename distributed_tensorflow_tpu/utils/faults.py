@@ -75,6 +75,17 @@ INJECTION_POINTS: dict[str, str] = {
                     "the fallback-ladder restore of a newer checkpoint "
                     "(file modes corrupt that newest set) "
                     "[ctx: path, step]",
+    "router_dispatch": "in serving.router before one dispatch attempt "
+                       "is sent to the chosen replica (error/refuse "
+                       "models a connect-fail the retry path must "
+                       "absorb) [ctx: replica, count]",
+    "router_health": "in the router's health poller before one "
+                     "replica's /healthz+/metrics poll (error models "
+                     "an unreachable replica — the breaker's poll-side "
+                     "feed) [ctx: replica, count]",
+    "router_hedge": "in the router's hedge timer after the latency "
+                    "budget expires, before the duplicate dispatch "
+                    "launches [ctx: request_id, count]",
     "preempt": "in the elasticity supervisor's boundary poll "
                "(training/elastic.py) — models a spot/preemptible "
                "capacity loss. mode=notice: advance warning, the run "

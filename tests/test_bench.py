@@ -345,6 +345,21 @@ def test_degraded_record_keeps_schedule_facts_non_null():
     assert rec["jaxprcheck_time_s"] is not None
 
 
+def test_degraded_record_keeps_router_facts_non_null():
+    """r22: the fleet-router drill is host-only (LocalTransport, no
+    chip), so its facts must survive outages — non-null in EVERY
+    record, degraded included."""
+    rec = bench.degraded_record("UNAVAILABLE: tunnel down", {},
+                                cpu_smoke=False)
+    assert rec["router_replicas"] == 2
+    assert rec["router_healthy"] is not None
+    assert rec["router_ejections"] >= 1  # the breaker drill tripped
+    assert rec["router_retries"] is not None
+    assert rec["router_hedges"] >= 1  # the hedge drill fired
+    assert rec["router_overhead_ms"] is not None
+    assert "router_error" not in rec
+
+
 def test_pp_skip_record_carries_schedule_facts():
     """Even the 1-chip skip record reports the (analytic) schedule
     facts alongside its null rates."""

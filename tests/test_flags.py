@@ -147,3 +147,35 @@ def test_pairing_validators_accept_valid_combinations():
     flags.FLAGS._parse(["--seq_parallel", "--sp_span_hosts",
                         "--model=lm", "--dataset=lm", "--model_axis=2"])
     assert flags.FLAGS.sp_span_hosts
+
+
+# ---- r22: the fleet router's flag surface --------------------------------
+
+
+@pytest.mark.parametrize("argv,needle", [
+    (["--router_port=70000"], "router_port"),
+    (["--router_poll_ms=1"], "router_poll_ms"),
+    (["--router_retries=11"], "router_retries"),
+    (["--router_retry_budget_pct=150"], "router_retry_budget_pct"),
+    (["--router_breaker_fails=0"], "router_breaker_fails"),
+    (["--router_eject_s=0"], "router_eject_s"),
+    (["--router_min_healthy=-1"], "router_min_healthy"),
+    # a floor the fleet can never satisfy is a config error, not a
+    # permanent 503: min_healthy must leave reload headroom
+    (["--router_replicas=a:1,b:2", "--router_min_healthy=2"],
+     "router_min_healthy"),
+    # hedging without telemetry is flying blind: armed deviation
+    # requires its evidence (the DTT006 telemetry-pairing pattern)
+    (["--router_hedge_ms=5", "--telemetry=false"], "router_hedge_ms"),
+])
+def test_router_flag_validators_reject_at_parse_time(argv, needle):
+    with pytest.raises(ValueError, match=needle):
+        flags.FLAGS._parse(argv)
+
+
+def test_router_flags_accept_a_full_fleet():
+    flags.FLAGS._parse(["--router_replicas=a:1,b:2,c:3",
+                        "--router_min_healthy=2", "--router_hedge_ms=5"])
+    assert flags.FLAGS.router_replicas == "a:1,b:2,c:3"
+    assert flags.FLAGS.router_min_healthy == 2
+    assert flags.FLAGS.router_hedge_ms == 5.0

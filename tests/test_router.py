@@ -1,0 +1,619 @@
+"""serving/router.py (r22) — the health-driven fleet router: p2c
+dispatch with id echo, budgeted retries and hedging, the
+drain/eject/half-open state machine, rolling reload under the
+min-healthy invariant, the HTTP front end + loadgen attribution, and
+the chaos contract (a SIGKILLed replica costs zero client requests)."""
+
+import json
+import os
+import queue as queue_mod
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.checkpoint import save_checkpoint
+from distributed_tensorflow_tpu.serving import reqtrace
+from distributed_tensorflow_tpu.serving.batcher import DynamicBatcher
+from distributed_tensorflow_tpu.serving.engine import InferenceEngine
+from distributed_tensorflow_tpu.serving.replica import (
+    HttpTransport,
+    LocalTransport,
+    Replica,
+    TransportError,
+)
+from distributed_tensorflow_tpu.serving.router import (
+    HealthPoller,
+    Router,
+    RouterServer,
+)
+from distributed_tensorflow_tpu.serving.server import (
+    InferenceServer,
+    InProcessClient,
+    make_predict_runner,
+)
+from distributed_tensorflow_tpu.utils import faults, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CPU_ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_spine():
+    """Quiet spine per test: faults disarmed, tracer ring cleared, the
+    process-global request plane saved/restored (the hedge test arms
+    it; nothing may leak into neighbors)."""
+    faults.reset()
+    telemetry.configure(logdir=None, enabled=True)
+    telemetry.get_tracer().clear()
+    prev = reqtrace.get_plane()
+    reqtrace._PLANE = None
+    yield
+    faults.reset()
+    telemetry.configure(logdir=None, enabled=True)
+    telemetry.get_tracer().clear()
+    reqtrace._PLANE = prev
+
+
+class _HostModel:
+    """Minimal host model (no jit): logits = x @ w + b."""
+
+    @staticmethod
+    def apply(params, x):
+        return np.asarray(x) @ params["w"] + params["b"]
+
+
+class _Flaky:
+    """Transport wrapper: switchable connect-fail + optional per-call
+    delay — the unreachable-replica and slow-replica stand-ins."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.fail = False
+        self.delay_s = 0.0
+
+    def get(self, path):
+        if self.fail:
+            raise TransportError("test: injected connect-fail")
+        return self.inner.get(path)
+
+    def post(self, path, obj):
+        if self.fail:
+            raise TransportError("test: injected connect-fail")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return self.inner.post(path, obj)
+
+
+class _Fleet:
+    """N in-process replicas over ONE logdir (checkpoint step 10),
+    dispatched through LocalTransport — no sockets unless a test
+    starts the servers itself."""
+
+    def __init__(self, tmpdir, n=2, **rep_kw):
+        self.dir = str(tmpdir)
+        rng = np.random.default_rng(0)
+        self.params = {
+            "w": rng.standard_normal((64, 16)).astype(np.float32),
+            "b": np.zeros(16, np.float32)}
+        save_checkpoint(self.dir, {"params": self.params}, 10)
+        kw = dict(breaker_fails=2, eject_s=0.2)
+        kw.update(rep_kw)
+        self.batchers, self.servers, self.replicas = [], [], []
+        for i in range(n):
+            eng = InferenceEngine(_HostModel(), self.dir, jit=False,
+                                  params_template=self.params,
+                                  max_batch=8)
+            b = DynamicBatcher(make_predict_runner(eng), max_batch=8,
+                               max_delay_ms=1.0, queue_depth=64,
+                               name=f"router-test-{i}")
+            self.batchers.append(b)
+            srv = InferenceServer(
+                eng, InProcessClient(predict_batcher=b), port=0)
+            self.servers.append(srv)
+            self.replicas.append(
+                Replica(f"r{i}", _Flaky(LocalTransport(srv)), **kw))
+        self.payload = {
+            "inputs": rng.standard_normal(64).astype(np.float32).tolist()}
+
+    def save(self, step):
+        save_checkpoint(self.dir, {"params": self.params}, step)
+
+    def close(self):
+        for b in self.batchers:
+            b.close(drain=False)
+        for s in self.servers:
+            if s._thread is not None:  # started: full shutdown
+                s.close()
+            else:  # never started: shutdown() would wait forever
+                s.httpd.server_close()
+
+
+def _recs():
+    return telemetry.last_spans(10 ** 6)
+
+
+# ------------------------------------------------------------ dispatch
+
+
+def test_p2c_spread_id_echo_and_served_step(tmp_path):
+    reqtrace.configure(enabled=True)  # served_step rides the traces
+    f = _Fleet(tmp_path, 2)
+    try:
+        router = Router(f.replicas, retries=2, backoff_ms=2.0,
+                        min_healthy=1, seed=0)
+        for i in range(30):
+            status, body, name = router.dispatch(
+                "/v1/predict", dict(f.payload), request_id=f"req-{i}")
+            assert status == 200, body
+            assert body["request_id"] == f"req-{i}"  # echo, always
+            assert name in ("r0", "r1")
+            assert body["served_step"] == 10  # the wire names the params
+        spread = [r.snapshot()["dispatches"] for r in f.replicas]
+        assert min(spread) > 0, f"p2c starved a replica: {spread}"
+        assert router.requests_total == 30
+    finally:
+        f.close()
+
+
+def test_retry_absorbs_connect_fail_and_names_the_ejection(tmp_path):
+    f = _Fleet(tmp_path, 2)
+    try:
+        router = Router(f.replicas, retries=2, backoff_ms=1.0,
+                        retry_budget_pct=100.0, min_healthy=1, seed=0)
+        f.replicas[0].transport.fail = True  # r0 unreachable
+        for i in range(8):
+            status, _body, name = router.dispatch(
+                "/v1/predict", dict(f.payload))
+            assert status == 200, "retry must absorb the outage"
+            assert name == "r1"
+        assert router.retries_total > 0
+        recs = _recs()
+        assert any(r.get("name") == "route_retry" for r in recs)
+        # breaker_fails=2: the outage is NAMED in the span ring
+        assert any(r.get("name") == "route_state"
+                   and r.get("transition") == "eject"
+                   and r.get("replica") == "r0" for r in recs)
+        assert f.replicas[0].snapshot()["ejections"] >= 1
+    finally:
+        f.close()
+
+
+def test_fault_point_router_dispatch_is_one_retry(tmp_path):
+    f = _Fleet(tmp_path, 2)
+    try:
+        faults.configure("router_dispatch:mode=error:times=1")
+        router = Router(f.replicas, retries=2, backoff_ms=1.0,
+                        min_healthy=1, seed=0)
+        status, body, _name = router.dispatch("/v1/predict",
+                                              dict(f.payload))
+        assert status == 200, body
+        assert router.retries_total == 1  # the injected fail, absorbed
+    finally:
+        f.close()
+
+
+def test_retry_budget_denies_past_the_floor(tmp_path):
+    f = _Fleet(tmp_path, 2)
+    try:
+        for rep in f.replicas:
+            rep.transport.fail = True  # total outage
+        router = Router(f.replicas, retries=10, backoff_ms=0.5,
+                        retry_budget_pct=0.0, min_healthy=1, seed=0)
+        status, body, name = router.dispatch("/v1/predict",
+                                             dict(f.payload))
+        assert status == 503 and name is None
+        assert body["request_id"]  # even the failure carries the id
+        # pct=0: only the burst floor's retries spent, then DENIED —
+        # a dead fleet degrades to honest errors, not a retry storm
+        from distributed_tensorflow_tpu.serving.router import (
+            RETRY_BURST_FLOOR,
+        )
+        assert router.retries_total == RETRY_BURST_FLOOR
+        assert router.retries_denied >= 1
+    finally:
+        f.close()
+
+
+# ------------------------------------------------- replica state machine
+
+
+class _Scripted:
+    """Healthz answers from a script; posts always succeed."""
+
+    def __init__(self):
+        self.healthz = (200, {"ok": True, "queue_depth": 0})
+
+    def get(self, path):
+        if path == "/healthz":
+            return self.healthz
+        return 200, {}
+
+    def post(self, path, obj):
+        return 200, {"ok": True}
+
+
+def test_drain_on_503_poll_and_undrain_on_recovery():
+    rep = Replica("s0", _Scripted(), breaker_fails=2, eject_s=0.1)
+    poller = HealthPoller([rep], interval_s=60)  # manual ticks only
+    poller.poll_once()
+    assert rep.is_healthy()
+    rep.transport.healthz = (503, {"ok": False, "reason": "hbm_low"})
+    poller.poll_once()
+    assert rep.state_name() == "draining"
+    assert not rep.dispatchable(time.monotonic())  # no NEW dispatch
+    rep.transport.healthz = (200, {"ok": True})
+    poller.poll_once()
+    assert rep.is_healthy()  # drain is reversible, poll-driven
+    names = [(r.get("name"), r.get("transition")) for r in _recs()]
+    assert ("route_state", "drain") in names
+    assert ("route_state", "undrain") in names
+
+
+def test_breaker_eject_half_open_probe_and_backoff():
+    rep = Replica("d0", _Scripted(), breaker_fails=3, eject_s=0.05)
+    for _ in range(3):
+        assert rep.begin_dispatch(time.monotonic())
+        rep.end_dispatch(False, time.monotonic())
+    assert rep.state_name() == "ejected"
+    assert not rep.dispatchable(time.monotonic())  # cooldown holds
+    time.sleep(0.06)
+    now = time.monotonic()
+    assert rep.dispatchable(now)  # half-open window opened
+    assert rep.begin_dispatch(now)  # claims THE probe slot
+    assert not rep.dispatchable(time.monotonic())  # exactly one probe
+    rep.end_dispatch(False, time.monotonic())  # probe fails: re-eject
+    snap = rep.snapshot()
+    assert snap["ejections"] == 2
+    assert snap["eject_cooldown_s"] > 0.05 * 1.5  # cooldown doubled
+    time.sleep(snap["eject_cooldown_s"] + 0.02)
+    assert rep.begin_dispatch(time.monotonic())
+    assert rep.end_dispatch(True, time.monotonic()) == "heal"
+    assert rep.is_healthy()
+
+
+def test_poll_connect_fail_feeds_the_breaker():
+    rep = Replica("p0", _Scripted(), breaker_fails=2, eject_s=0.1)
+    down = _Flaky(rep.transport)
+    rep.transport = down
+    down.fail = True
+    poller = HealthPoller([rep], interval_s=60)
+    poller.poll_once()
+    poller.poll_once()
+    assert rep.state_name() == "ejected"
+    assert any(r.get("name") == "route_state"
+               and r.get("transition") == "eject"
+               and r.get("source") == "poll" for r in _recs())
+
+
+# -------------------------------------------------------------- hedging
+
+
+def test_hedge_wins_and_slo_books_exactly_one_outcome(tmp_path):
+    plane = reqtrace.configure(enabled=True, slo_p99_ms=60_000.0)
+    f = _Fleet(tmp_path, 2)
+    try:
+        # the FIRST post (the primary, whichever replica it picked) is
+        # slow; the hedge's post runs clean — so the hedge wins the
+        # race while the primary still completes server-side
+        calls = [0]
+        lock = threading.Lock()
+        real_posts = {r.name: r.transport.post for r in f.replicas}
+
+        def _slow_first(name):
+            def post(path, obj):
+                with lock:
+                    first = calls[0] == 0
+                    calls[0] += 1
+                if first:
+                    time.sleep(0.15)
+                return real_posts[name](path, obj)
+            return post
+
+        for r in f.replicas:
+            r.transport.post = _slow_first(r.name)
+        router = Router(f.replicas, retries=1, backoff_ms=1.0,
+                        hedge_ms=20.0, hedge_budget_pct=100.0,
+                        min_healthy=1, seed=0)
+        status, body, _name = router.dispatch(
+            "/v1/predict", dict(f.payload), request_id="hedge-1")
+        assert status == 200
+        assert body["request_id"] == "hedge-1"
+        assert router.hedges_total == 1
+        assert router.hedge_wins == 1
+        # BOTH arms reached an engine with the same id, yet the SLO
+        # ledger booked exactly ONE outcome (reqtrace's r22 dedupe)
+        assert plane.slo.total == 1
+        assert plane.slo_deduped == 1
+        assert any(r.get("name") == "route_hedge" for r in _recs())
+    finally:
+        f.close()
+
+
+def test_hedge_stays_home_when_primary_already_resolved(tmp_path):
+    f = _Fleet(tmp_path, 2)
+    try:
+        router = Router(f.replicas, retries=1, backoff_ms=1.0,
+                        hedge_ms=5_000.0, hedge_budget_pct=100.0,
+                        min_healthy=1, seed=0)
+        status, _body, _name = router.dispatch("/v1/predict",
+                                               dict(f.payload))
+        assert status == 200
+        assert router.hedges_total == 0  # timer cancelled, no join
+    finally:
+        f.close()
+
+
+def test_reqtrace_slo_dedupe_window_books_once_then_expires():
+    plane = reqtrace.RequestPlane(slo_p99_ms=60_000.0,
+                                  dedupe_window_s=0.05)
+    for _ in range(2):  # a hedged/retried pair reusing the id
+        tr = plane.begin("dup-1", "predict", np.zeros(4, np.float32))
+        plane.finish(tr, "ok")
+    assert plane.slo.total == 1  # first finish books...
+    assert plane.slo_deduped == 1  # ...the duplicate only counts here
+    time.sleep(0.06)  # past the window: the id is a NEW request now
+    tr = plane.begin("dup-1", "predict", np.zeros(4, np.float32))
+    plane.finish(tr, "ok")
+    assert plane.slo.total == 2
+    assert plane.slo_deduped == 1
+
+
+# ------------------------------------------------------- rolling reload
+
+
+def test_rolling_reload_min_healthy_and_monotonic_served_step(tmp_path):
+    reqtrace.configure(enabled=True)  # served_step rides the traces
+    f = _Fleet(tmp_path, 3)
+    try:
+        router = Router(f.replicas, retries=2, backoff_ms=1.0,
+                        min_healthy=2, seed=0)
+        poller = HealthPoller(f.replicas, interval_s=60)
+        poller.poll_once()
+        served = {}
+
+        def hit(n):
+            for _ in range(n):
+                status, body, name = router.dispatch(
+                    "/v1/predict", dict(f.payload))
+                assert status == 200, body
+                served.setdefault(name, []).append(body["served_step"])
+
+        hit(9)
+        f.save(20)
+        report = router.rolling_reload(poller, timeout_s=30.0)
+        assert report["ok"], report
+        # the invariant: the fleet NEVER dropped below min_healthy
+        assert report["min_healthy_observed"] >= 2
+        assert len(report["replicas"]) == 3
+        for entry in report["replicas"]:
+            assert entry["reloaded"], entry
+            assert entry["params_step"] == 20
+        hit(9)
+        # per-replica: steps only move forward, and every response is
+        # whole — one step per batch, never a mixed-step answer
+        for name, seq in served.items():
+            assert seq == sorted(seq), f"{name} served {seq}"
+            assert set(seq) <= {10, 20}
+        assert {10, 20} <= {s for seq in served.values() for s in seq}
+        # nobody left admin-drained, everyone dispatchable again
+        assert router.healthy_count() == 3
+        reloads = [r for r in _recs()
+                   if r.get("name") == "route_state"
+                   and r.get("transition") == "reload"]
+        assert {r.get("replica") for r in reloads} == {"r0", "r1", "r2"}
+    finally:
+        f.close()
+
+
+# ---------------------------------------------- HTTP front end + loadgen
+
+
+def test_router_server_loadgen_attribution_and_fleet_table(tmp_path):
+    f = _Fleet(tmp_path, 2)
+    router = Router(f.replicas, retries=2, backoff_ms=1.0,
+                    min_healthy=1, seed=0)
+    rs = RouterServer(router, HealthPoller(f.replicas, interval_s=0.05),
+                      port=0).start_background()
+    try:
+        from tools.serve_loadgen import http_request_fn, run_closed_loop
+
+        fn = http_request_fn(rs.address, "predict", input_dim=64)
+        rep = run_closed_loop(fn, n_requests=24, concurrency=3)
+        assert rep["errors"] == 0 and rep["rejected"] == 0
+        assert rep["id_echo_failures"] == 0
+        assert rep["ok"] == 24
+        # X-DTT-Replica attribution -> the per_replica columns
+        per = rep["per_replica"]
+        assert per and set(per) <= {"r0", "r1"}
+        assert sum(e["ok"] for e in per.values()) == 24
+        for e in per.values():
+            assert e["p99_ms"] >= e["p50_ms"] >= 0
+
+        t = HttpTransport(rs.address)
+        st, hz = t.get("/healthz")
+        assert st == 200 and hz["ok"] and hz["healthy"] == 2
+
+        from tools.router_report import load_fleet, render
+
+        table = render(load_fleet(rs.address))
+        assert "r0" in table and "r1" in table
+        assert "fleet: 2/2 healthy" in table
+    finally:
+        rs.close()
+        f.close()
+
+
+def test_router_healthz_503_below_min_healthy(tmp_path):
+    f = _Fleet(tmp_path, 2)
+    router = Router(f.replicas, retries=1, backoff_ms=1.0,
+                    min_healthy=2, seed=0)
+    rs = RouterServer(router, HealthPoller(f.replicas, interval_s=60),
+                      port=0).start_background()
+    try:
+        f.replicas[1].set_admin_drain(True)  # healthy 1 < floor 2
+        st, body = HttpTransport(rs.address).get("/healthz")
+        assert st == 503 and not body["ok"]
+        assert body["healthy"] == 1 and body["min_healthy"] == 2
+    finally:
+        rs.close()
+        f.close()
+
+
+def test_loadgen_multi_target_attributes_by_url(tmp_path):
+    f = _Fleet(tmp_path, 2)
+    try:
+        for srv in f.servers:
+            srv.start_background()
+        from tools.serve_loadgen import multi_target_fn, run_closed_loop
+
+        urls = [srv.address for srv in f.servers]
+        fn = multi_target_fn(urls, "predict", input_dim=64)
+        rep = run_closed_loop(fn, n_requests=12, concurrency=2)
+        assert rep["errors"] == 0 and rep["ok"] == 12
+        per = rep["per_replica"]
+        assert per and len(per) == 2  # one column per target URL
+        assert sum(e["ok"] for e in per.values()) == 12
+    finally:
+        f.close()
+
+
+def test_router_report_json_file_and_exit_codes(tmp_path, capsys):
+    from tools import router_report
+
+    fleet = {"replicas": [
+        {"name": "a:1", "state": "healthy", "dispatches": 30,
+         "inflight": 0, "ejections": 0},
+        {"name": "b:2", "state": "ejected", "dispatches": 10,
+         "inflight": 0, "ejections": 2, "eject_cooldown_s": 1.5},
+    ], "healthy": 1, "min_healthy": 1, "requests_total": 40,
+        "retries_total": 3, "retries_denied": 0, "hedges_total": 0,
+        "hedges_denied": 0, "hedge_wins": 0}
+    path = tmp_path / "fleet.json"
+    path.write_text(json.dumps(fleet))
+    assert router_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "a:1" in out and "ejected" in out
+    fleet["healthy"] = 0  # below the floor: scriptable exit 1
+    path.write_text(json.dumps(fleet))
+    assert router_report.main([str(path)]) == 1
+    assert router_report.main([str(tmp_path / "missing.json")]) == 2
+
+
+# ----------------------------------------------------------------- chaos
+
+
+def _spawn_replica(logdir):
+    p = subprocess.Popen(
+        [sys.executable, "-u", "-m", "distributed_tensorflow_tpu.serving",
+         f"--logdir={logdir}", "--model=mlp", "--dataset=mnist",
+         "--serve_port=0", "--serve_reload_secs=0",
+         "--serve_max_delay_ms=2"],
+        cwd=REPO, env=CPU_ENV, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    lines: queue_mod.Queue = queue_mod.Queue()
+    threading.Thread(target=lambda: [lines.put(l) for l in p.stdout],
+                     daemon=True).start()
+    return p, lines
+
+
+def _wait_url(p, lines, deadline_s=240):
+    seen = []
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if p.poll() is not None:
+            break
+        try:
+            line = lines.get(timeout=5)
+        except queue_mod.Empty:
+            continue
+        seen.append(line)
+        m = re.search(r"serving on (http://\S+)", line)
+        if m:
+            return m.group(1)
+    pytest.fail(f"replica never came up: {''.join(seen)[-2000:]}")
+
+
+@pytest.mark.slow  # chaos: two full serving subprocesses + a SIGKILL
+def test_chaos_sigkill_replica_mid_sweep_zero_failed_requests(tmp_path):
+    """The r22 acceptance drill: SIGKILL one of two live replicas in
+    the middle of a loadgen sweep. The router's retries absorb the
+    outage onto the survivor — zero failed client requests, every id
+    echo-verified, no SLO fast-burn on the survivor, and the ejection
+    NAMED in the span ring and the flight recorder."""
+    from distributed_tensorflow_tpu.models.mlp import MLP
+    from distributed_tensorflow_tpu.training import create_train_state, sgd
+    from tools.serve_loadgen import http_request_fn, run_closed_loop
+
+    logdir = str(tmp_path / "logs")
+    state = create_train_state(MLP(), sgd(0.1), seed=0)
+    save_checkpoint(logdir, state, 10)
+
+    telemetry.configure(logdir=str(tmp_path), enabled=True)
+    procs = []
+    rs = None
+    try:
+        procs = [_spawn_replica(logdir) for _ in range(2)]
+        urls = [_wait_url(p, lines) for p, lines in procs]
+        replicas = [Replica(f"r{i}", HttpTransport(u),
+                            breaker_fails=2, eject_s=0.3)
+                    for i, u in enumerate(urls)]
+        router = Router(replicas, retries=3, backoff_ms=10.0,
+                        retry_budget_pct=100.0, min_healthy=1, seed=0)
+        poller = HealthPoller(replicas, interval_s=0.1)
+        rs = RouterServer(router, poller, port=0).start_background()
+
+        n_requests = 120
+        fn = http_request_fn(rs.address, "predict", input_dim=784)
+        holder = []
+        sweep = threading.Thread(
+            target=lambda: holder.append(
+                run_closed_loop(fn, n_requests=n_requests,
+                                concurrency=4)),
+            daemon=True)
+        sweep.start()
+        deadline = time.time() + 120
+        while router.requests_total < n_requests // 4:
+            assert time.time() < deadline, "sweep never progressed"
+            time.sleep(0.02)
+        procs[1][0].kill()  # SIGKILL, mid-sweep
+        sweep.join(timeout=240)
+        assert not sweep.is_alive(), "loadgen sweep hung after the kill"
+
+        rep = holder[0]
+        assert rep["ok"] == n_requests  # ZERO failed client requests
+        assert rep["errors"] == 0
+        assert rep["rejected"] == 0
+        assert rep["id_echo_failures"] == 0
+        # the survivor took the traffic and is not burning its budget
+        st, hz = HttpTransport(urls[0]).get("/healthz")
+        assert st == 200 and hz["ok"]
+        assert not hz.get("slo_fast_burn")
+        # the ejection is NAMED: span ring + flight recorder
+        deadline = time.time() + 10
+        while (replicas[1].state_name() != "ejected"
+               and time.time() < deadline):
+            poller.poll_once()
+            time.sleep(0.05)
+        assert replicas[1].state_name() == "ejected"
+        assert any(r.get("name") == "route_state"
+                   and r.get("transition") == "eject"
+                   and r.get("replica") == "r1" for r in _recs())
+        fr = telemetry.flight_recorder().dump("router-chaos-test")
+        assert fr is not None
+        with open(fr) as fh:
+            recs = [json.loads(line) for line in fh]
+        assert any(r.get("kind") == "router"
+                   and r.get("transition") == "eject"
+                   and r.get("replica") == "r1" for r in recs)
+    finally:
+        if rs is not None:
+            rs.close()
+        for p, _lines in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)

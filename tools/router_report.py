@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""The fleet table — one line per replica from a live router's
+``/metrics`` (or a saved fleet-report JSON file): state, dispatch
+share, in-flight, params step, breaker history, and the router's own
+retry/hedge budget counters.
+
+The router (serving/router.py) already serves everything as JSON; this
+tool is the human rendering — what you glance at mid-incident to see
+WHICH replica is ejected, how the traffic spread looks, and whether the
+retry budget is absorbing or denying.
+
+Usage:
+    python tools/router_report.py http://127.0.0.1:8100
+    python tools/router_report.py fleet.json
+    python tools/router_report.py http://127.0.0.1:8100 --json
+
+Exit codes: 0 = healthy count >= the router's min_healthy floor;
+1 = below the floor (scriptable as a fleet check); 2 = unreachable /
+unparseable input.
+
+stdlib-only, no jax, no chip — run it anywhere the router answers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+
+def load_fleet(source: str, timeout_s: float = 10.0) -> dict:
+    """A fleet report from a router URL (GET /metrics) or a JSON file."""
+    if source.startswith(("http://", "https://")) or ":" in source \
+            and not os.path.exists(source):
+        url = source if "://" in source else f"http://{source}"
+        req = urllib.request.Request(url.rstrip("/") + "/metrics",
+                                     method="GET")
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+    with open(source, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def render(fleet: dict) -> str:
+    lines = []
+    total = sum(r.get("dispatches") or 0
+                for r in fleet.get("replicas", ()))
+    lines.append(
+        f"fleet: {fleet.get('healthy')}/{len(fleet.get('replicas', ()))}"
+        f" healthy (floor {fleet.get('min_healthy')}) · "
+        f"requests {fleet.get('requests_total')} · "
+        f"retries {fleet.get('retries_total')}"
+        f" (denied {fleet.get('retries_denied')}) · "
+        f"hedges {fleet.get('hedges_total')}"
+        f" (wins {fleet.get('hedge_wins')},"
+        f" denied {fleet.get('hedges_denied')})")
+    header = (f"{'replica':<24} {'state':<9} {'share':>6} {'infl':>5} "
+              f"{'queue':>5} {'step':>6} {'fails':>5} {'ejects':>6} "
+              f"{'cooldown':>8} {'goodput':>8}")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for rep in fleet.get("replicas", ()):
+        share = (100.0 * (rep.get("dispatches") or 0) / total
+                 if total else 0.0)
+        state = rep.get("state", "?")
+        if rep.get("admin_drain"):
+            state += "*"  # admin-drained (rolling reload in progress)
+        goodput = rep.get("goodput_uptime_pct")
+        cooldown = rep.get("eject_cooldown_s") or 0.0
+        lines.append(
+            f"{rep.get('name', '?'):<24} {state:<9} {share:>5.1f}% "
+            f"{rep.get('inflight') or 0:>5} "
+            f"{rep.get('queue_depth') if rep.get('queue_depth') is not None else '-':>5} "
+            f"{rep.get('params_step') if rep.get('params_step') is not None else '-':>6} "
+            f"{rep.get('consecutive_failures') or 0:>5} "
+            f"{rep.get('ejections') or 0:>6} "
+            f"{cooldown:>7.1f}s "
+            f"{f'{goodput:.1f}%' if goodput is not None else '-':>8}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("source", help="router URL (http://host:port) or a "
+                                   "saved fleet-report JSON file")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw fleet report JSON instead of "
+                         "the table")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    try:
+        fleet = load_fleet(args.source, timeout_s=args.timeout)
+    except (OSError, urllib.error.URLError, ValueError) as e:
+        print(f"router_report: cannot load {args.source}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(fleet, indent=2, default=str))
+    else:
+        print(render(fleet))
+    healthy = fleet.get("healthy")
+    floor = fleet.get("min_healthy")
+    if healthy is not None and floor is not None and healthy < floor:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,10 @@ class _Counters:
         self.id_echo_failures = 0
         self.slo_compliant = 0
         self.phase_hists: dict[str, StreamingHistogram] = {}
+        # per-replica attribution (r22): keyed on the router's
+        # X-DTT-Replica response header (or the direct target URL) —
+        # {name: {"ok": n, "rejected": n, "hist": StreamingHistogram}}
+        self.replica_stats: dict[str, dict] = {}
 
     def add(self, kind: str):
         with self.lock:
@@ -75,6 +79,18 @@ class _Counters:
                 if h is None:
                     h = self.phase_hists[phase] = StreamingHistogram()
                 h.record(float(ms))
+
+    def replica(self, name: str, kind: str,
+                latency_ms: float | None = None):
+        with self.lock:
+            entry = self.replica_stats.get(name)
+            if entry is None:
+                entry = self.replica_stats[name] = {
+                    "ok": 0, "rejected": 0,
+                    "hist": StreamingHistogram()}
+            entry[kind] += 1
+            if latency_ms is not None:
+                entry["hist"].record(latency_ms)
 
 
 def _report(hist: StreamingHistogram, c: _Counters, elapsed_s: float,
@@ -98,6 +114,13 @@ def _report(hist: StreamingHistogram, c: _Counters, elapsed_s: float,
                     "p99": round(h.quantile(0.99), 3),
                     "mean": round(h.mean, 3)}
             for phase, h in sorted(c.phase_hists.items())} or None
+        # r22: which replica served what — present when responses carry
+        # the router's X-DTT-Replica header (or --targets fanned out)
+        out["per_replica"] = {
+            name: {"ok": entry["ok"], "rejected": entry["rejected"],
+                   "p50_ms": round(entry["hist"].quantile(0.5), 3),
+                   "p99_ms": round(entry["hist"].quantile(0.99), 3)}
+            for name, entry in sorted(c.replica_stats.items())} or None
     if slo_p99_ms and slo_p99_ms > 0:
         out["slo_p99_ms"] = slo_p99_ms
         total = c.ok + c.rejected + c.errors
@@ -116,13 +139,19 @@ def _call_and_record(request_fn, hist: StreamingHistogram, c: _Counters,
         c.add("ok")
         if slo_p99_ms and latency_ms <= slo_p99_ms:
             c.add("slo_compliant")
-        if isinstance(meta, dict) and meta.get("phases_ms"):
-            c.phases(meta["phases_ms"])
+        if isinstance(meta, dict):
+            if meta.get("phases_ms"):
+                c.phases(meta["phases_ms"])
+            if meta.get("replica"):
+                c.replica(meta["replica"], "ok", latency_ms)
     except EchoMismatchError:
         c.add("id_echo_failures")
         c.add("errors")
-    except RejectedError:
+    except RejectedError as e:
         c.add("rejected")
+        name = getattr(e, "replica", None)
+        if name:
+            c.replica(name, "rejected")
     except Exception:  # noqa: BLE001 — the loadgen reports, not raises
         c.add("errors")
 
@@ -294,25 +323,69 @@ def http_request_fn(url: str, kind: str, *, prompt_len: int = 8,
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
                 out = json.loads(resp.read())
+                replica = resp.headers.get("X-DTT-Replica")
         except urllib.error.HTTPError as e:
             if e.code == 429:
-                raise RejectedError(f"HTTP 429: {e.read()[:200]}",
-                                    request_id=rid) from e
+                err = RejectedError(f"HTTP 429: {e.read()[:200]}",
+                                    request_id=rid)
+                # the router stamps attribution on rejections too
+                err.replica = e.headers.get("X-DTT-Replica")
+                raise err from e
             raise
         echoed = out.get("request_id")
         if echoed != rid:
             raise EchoMismatchError(
                 f"sent request_id {rid!r}, response echoed {echoed!r}")
         return {"request_id": echoed,
-                "phases_ms": out.get("phases_ms")}
+                "phases_ms": out.get("phases_ms"),
+                "replica": replica}
+
+    return call
+
+
+def multi_target_fn(urls, kind: str, **kw):
+    """Round-robin fan-out over several direct replica URLs — the
+    router-less baseline for per-replica attribution (each response is
+    attributed to the target that served it, standing in for the
+    X-DTT-Replica header a router would stamp)."""
+    fns = []
+    for u in urls:
+        if "://" not in u:
+            u = "http://" + u
+        inner = http_request_fn(u, kind, **kw)
+        fns.append((u, inner))
+    lock = threading.Lock()
+    count = [0]
+
+    def call():
+        with lock:
+            i = count[0] % len(fns)
+            count[0] += 1
+        name, inner = fns[i]
+        try:
+            meta = inner()
+        except RejectedError as e:
+            if not getattr(e, "replica", None):
+                e.replica = name
+            raise
+        if isinstance(meta, dict) and not meta.get("replica"):
+            meta["replica"] = name
+        return meta
 
     return call
 
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--url", required=True,
-                    help="serving endpoint, e.g. http://127.0.0.1:8000")
+    ap.add_argument("--url", default="",
+                    help="serving (or router) endpoint, e.g. "
+                         "http://127.0.0.1:8000 — responses carrying "
+                         "the router's X-DTT-Replica header populate "
+                         "the per_replica columns")
+    ap.add_argument("--targets", default="",
+                    help="comma-separated host:port replica list to "
+                         "round-robin directly (router-less fan-out); "
+                         "mutually exclusive with --url")
     ap.add_argument("--mode", choices=("open", "closed"), default="closed")
     ap.add_argument("--kind", choices=("predict", "generate"),
                     default="predict")
@@ -348,19 +421,24 @@ def main():
                          "(slo_compliant_pct) to the summary")
     args = ap.parse_args()
 
-    fn = http_request_fn(args.url, args.kind, prompt_len=args.prompt_len,
-                         vocab_size=args.vocab_size,
-                         input_dim=args.input_dim,
-                         max_new_tokens=args.max_new_tokens)
+    targets = [t.strip() for t in args.targets.split(",") if t.strip()]
+    if bool(args.url) == bool(targets):
+        ap.error("exactly one of --url or --targets is required")
+    kw = dict(prompt_len=args.prompt_len, vocab_size=args.vocab_size,
+              input_dim=args.input_dim,
+              max_new_tokens=args.max_new_tokens)
+    if targets:
+        fn = multi_target_fn(targets, args.kind, **kw)
+    else:
+        fn = http_request_fn(args.url, args.kind, **kw)
     if args.mix == "long_tail":
         if args.kind != "generate":
             ap.error("--mix long_tail requires --kind generate")
         long_n = args.long_tokens or 8 * args.max_new_tokens
-        long = http_request_fn(args.url, args.kind,
-                               prompt_len=args.prompt_len,
-                               vocab_size=args.vocab_size,
-                               input_dim=args.input_dim,
-                               max_new_tokens=long_n)
+        long_kw = {**kw, "max_new_tokens": long_n}
+        long = (multi_target_fn(targets, args.kind, **long_kw)
+                if targets else
+                http_request_fn(args.url, args.kind, **long_kw))
         fn = long_tail_fn(fn, long, long_every=args.long_every)
     slo = args.slo_p99_ms if args.slo_p99_ms > 0 else None
     if args.knee_rates:
