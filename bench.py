@@ -2380,6 +2380,54 @@ def jaxprcheck_phase() -> dict:
         return dict(_JAXPRCHECK_CACHE["out"])
 
 
+_PERFCHECK_CACHE: dict = {}
+
+
+def perfcheck_phase() -> dict:
+    """dttperf drill (r23): run the performance-contract analyzer —
+    predicted step time per canonical (mode x model) cell from the
+    verified analytics, banded against the measured record rates, plus
+    the fact-coverage and wall-time-budget closures. HOST-ONLY (pure
+    Python + ``jax.eval_shape``, no chip), so the ``perfcheck_*`` facts
+    stay NON-NULL in EVERY record including the degraded/outage one,
+    per the bench contract. PROGRESS tracks ``perfcheck_findings_total``
+    staying at zero (findings + stale suppressions: an out-of-band rate
+    means this tree made a step slower than the analytic band allows,
+    a stale entry means a dead suppression lingers) with
+    ``perfcheck_band_pct`` holding the in-band share of banded record
+    rates. Cached per process (the jaxprcheck pattern): the full record
+    AND the degraded record both emit the facts, and the full pass
+    costs ~10s — the matrix cannot change mid-process."""
+    if "out" in _PERFCHECK_CACHE:
+        return dict(_PERFCHECK_CACHE["out"])
+    try:
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools.dttperf import run_perf
+
+        t0 = time.perf_counter()
+        res = run_perf()
+        _PERFCHECK_CACHE["out"] = {
+            "perfcheck_findings_total":
+                len(res.findings) + len(res.stale),
+            "perfcheck_scenarios_proven":
+                res.report["scenarios_proven"],
+            "perfcheck_band_pct": res.report["in_band_pct"],
+            "perfcheck_time_s": round(time.perf_counter() - t0, 3),
+        }
+        return dict(_PERFCHECK_CACHE["out"])
+    except Exception as e:  # never kill the record over the drill
+        _PERFCHECK_CACHE["out"] = {
+            "perfcheck_findings_total": None,
+            "perfcheck_scenarios_proven": None,
+            "perfcheck_band_pct": None,
+            "perfcheck_time_s": None,
+            "perfcheck_error": f"{type(e).__name__}: {e}"[:200]}
+        return dict(_PERFCHECK_CACHE["out"])
+
+
 def elastic_phase() -> dict:
     """Elastic-resize drill (r15): drive the detect -> drain -> adopt ->
     restore ladder end to end on a tiny host state — the REAL machinery
@@ -2653,6 +2701,9 @@ def degraded_record(error, init_info: dict, partial: dict | None = None,
     # r18: the dttcheck drill runs in its own CPU-mesh subprocess —
     # the jaxpr-proof facts stay non-null through outages too
     out.update(jaxprcheck_phase())
+    # r23: the dttperf drill is host-only (analytics + eval_shape) —
+    # the performance-contract facts stay non-null through outages too
+    out.update(perfcheck_phase())
     if partial:
         out.update(partial)
     return out
@@ -2796,6 +2847,10 @@ def _run_phases(out: dict):
     # with its own virtual CPU mesh; a nonzero finding count means an
     # analytic ledger drifted from what the compiler actually lowers)
     out.update(jaxprcheck_phase())
+    # r23: dttperf — the step-time predictions banded against this very
+    # record's measured rates (a nonzero finding count means a rate
+    # left its analytic band: a named performance regression)
+    out.update(perfcheck_phase())
 
     print(json.dumps(out))
 

@@ -1,0 +1,19 @@
+"""DTT011 bad fixture: three public bench phases — one fact-covered
+(quiet), one in neither table (finding), one exempted with a bare
+non-string reason (finding)."""
+
+
+def covered_phase() -> dict:
+    return {"covered_total": 1}
+
+
+def uncovered_phase() -> dict:
+    return {"uncovered_rate": 2.0}
+
+
+def bare_exempt_phase() -> dict:
+    return {"bare_rate": 3.0}
+
+
+def _private_helper_phase() -> dict:  # private: out of scope
+    return {}

@@ -1,0 +1,11 @@
+"""DTT011 bad fixture: the coverage tables miss uncovered_phase and
+exempt bare_exempt_phase without a string reason."""
+
+PHASE_FACTS: dict = {
+    "covered_phase": dict(keys=("covered_total",),
+                          error_key="covered_error"),
+}
+
+PHASE_EXEMPT: dict = {
+    "bare_exempt_phase": None,  # not a reason string: rejected
+}

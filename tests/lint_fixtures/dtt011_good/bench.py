@@ -1,0 +1,14 @@
+"""DTT011 good fixture: every public phase is fact-covered or
+exempted with a stated reason."""
+
+
+def covered_phase() -> dict:
+    return {"covered_total": 1}
+
+
+def uncovered_phase() -> dict:
+    return {"uncovered_rate": 2.0}
+
+
+def bare_exempt_phase() -> dict:
+    return {"bare_rate": 3.0}

@@ -1,0 +1,11 @@
+"""DTT011 good fixture: the conforming coverage tables."""
+
+PHASE_FACTS: dict = {
+    "covered_phase": dict(keys=("covered_total",),
+                          error_key="covered_error"),
+}
+
+PHASE_EXEMPT: dict = {
+    "uncovered_phase": "a measured rate DTP001 bands; no analytic facts",
+    "bare_exempt_phase": "chip-gated A/B — rates stay null off-chip",
+}

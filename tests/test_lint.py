@@ -28,6 +28,7 @@ from tools.dttlint.rules import (  # noqa: E402
     rule_flag_validator,
     rule_inventory_coverage,
     rule_ledger_coverage,
+    rule_perf_coverage,
     rule_scalar_contract,
     rule_span_taxonomy,
     rule_trace_purity,
@@ -77,6 +78,8 @@ FIXTURE_MATRIX = [
      ("parallel/mod.py", "tools/dttcheck/refs.py"), None, "DTT009", 1),
     (rule_inventory_coverage, "dtt010_bad",
      ("code.py", "tools/dttsan/stub.py"), None, "DTT010", 2),
+    (rule_perf_coverage, "dtt011_bad",
+     ("bench.py", "tools/dttperf/records.py"), None, "DTT011", 2),
 ]
 
 
@@ -143,7 +146,7 @@ def test_repo_lints_clean_with_checked_in_baseline():
     assert res.findings == [], \
         "new findings:\n" + "\n".join(f.format() for f in res.findings)
     assert res.stale == [], res.stale
-    assert len(res.rules) == 10
+    assert len(res.rules) == 11
     assert dt < 10.0, f"lint took {dt:.1f}s (>10s acceptance budget)"
     assert res.baselined, "baseline is empty — update this test if " \
                           "the tree went fully clean"
@@ -188,7 +191,7 @@ def test_cli_exits_zero_and_emits_json():
     assert p.returncode == 0, p.stdout + p.stderr
     out = json.loads(p.stdout)
     assert out["ok"] and out["findings"] == []
-    assert len(out["rules"]) == 10
+    assert len(out["rules"]) == 11
 
 
 def test_cli_exits_nonzero_on_new_violation(tmp_path):
@@ -242,7 +245,7 @@ def test_scalar_contract_sees_all_loop_variants():
 
 def test_all_rules_registered():
     assert [r.rule_id for r in ALL_RULES] == [
-        f"DTT00{i}" for i in range(1, 10)] + ["DTT010"]
+        f"DTT00{i}" for i in range(1, 10)] + ["DTT010", "DTT011"]
 
 
 def test_dtt009_names_the_orphan_and_guards_self_disable():
@@ -271,4 +274,22 @@ def test_dtt010_names_the_unresolvable_and_guards_self_disable():
     assert all("inventory" in f.message for f in res.findings)
     res2 = _lint(rule_inventory_coverage, "dtt010_bad", "code.py")
     assert [f.rule for f in res2.findings] == ["DTT010"]
+    assert "self-disable" in res2.findings[0].message
+
+
+def test_dtt011_names_the_hole_and_guards_self_disable():
+    """DTT011 (r23): the phase in neither table is NAMED, the
+    bare-reason exemption is rejected with its own message, the
+    fact-covered phase stays quiet; a walk set with bench phases but
+    no tools/dttperf sources is itself a finding."""
+    res = _lint(rule_perf_coverage, "dtt011_bad",
+                "bench.py", "tools/dttperf/records.py")
+    assert sorted(f.key for f in res.findings) == [
+        "bench.py::bare_exempt_phase", "bench.py::uncovered_phase"]
+    by_key = {f.key: f.message for f in res.findings}
+    assert "unexplained exemption" in by_key["bench.py::bare_exempt_phase"]
+    assert "neither PHASE_FACTS nor PHASE_EXEMPT" in \
+        by_key["bench.py::uncovered_phase"]
+    res2 = _lint(rule_perf_coverage, "dtt011_bad", "bench.py")
+    assert [f.rule for f in res2.findings] == ["DTT011"]
     assert "self-disable" in res2.findings[0].message

@@ -1,20 +1,23 @@
 """``python -m tools.analyze`` — the one-command static-analysis gate:
 dttlint (AST invariants) + dttcheck (jaxpr proofs) + dttsan (host-plane
-concurrency), one merged exit code.
+concurrency) + dttperf (performance contracts), one merged exit code.
 
-The three analyzers prove three layers of the same tree — what the
-source SAYS (dttlint, rules DTT001-DTT010), what the compiler LOWERS
-(dttcheck, passes DTC001-DTC004), and what the host THREADS do (dttsan,
-passes SAN001-SAN004) — and they share one suppression discipline
-(``tools/_analysis_common``: baseline by stable key, mandatory reasons,
-stale entries fail loudly). This runner is the verify-pipeline entry:
-exit 0 only when ALL THREE are clean, ``--json`` merges the three
-reports into one object keyed by analyzer.
+The four analyzers prove four layers of the same tree — what the
+source SAYS (dttlint, rules DTT001-DTT011), what the compiler LOWERS
+(dttcheck, passes DTC001-DTC004), what the host THREADS do (dttsan,
+passes SAN001-SAN004), and what the program COSTS in time (dttperf,
+passes DTP000-DTP003: predicted step time per canonical cell banded
+against the measured bench records) — and they share one suppression
+discipline (``tools/_analysis_common``: baseline by stable key,
+mandatory reasons, stale entries fail loudly). This runner is the
+verify-pipeline entry: exit 0 only when ALL FOUR are clean, ``--json``
+merges the four reports into one object keyed by analyzer.
 
 dttcheck needs an 8-device mesh that must exist BEFORE jax initializes;
 like bench's jaxprcheck_phase it runs in a subprocess with a forced CPU
-mesh, so this command is chip-free end to end (the acceptance budget is
-< 30 s for the full triple).
+mesh, so this command is chip-free end to end. dttperf is chip-free by
+construction (pure Python + ``jax.eval_shape``). The acceptance budget
+is < 45 s for all four (DTP003 budget ``analyze_umbrella_wall_s``).
 
 Usage: python -m tools.analyze [--json] [--skip dttcheck] ...
 """
@@ -33,7 +36,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from tools._analysis_common import REPO_ROOT  # noqa: E402
 
-ANALYZERS = ("dttlint", "dttcheck", "dttsan")
+ANALYZERS = ("dttlint", "dttcheck", "dttsan", "dttperf")
 
 
 def _run_dttlint() -> dict:
@@ -46,6 +49,14 @@ def _run_dttsan() -> dict:
     from tools.dttsan import run_san
 
     return run_san().to_json()
+
+
+def _run_dttperf() -> dict:
+    """In-process like dttlint/dttsan: predictions are pure Python +
+    ``jax.eval_shape`` — no mesh, no devices, so no subprocess."""
+    from tools.dttperf import run_perf
+
+    return run_perf().to_json()
 
 
 def _run_dttcheck() -> dict:
@@ -70,8 +81,8 @@ def _run_dttcheck() -> dict:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.analyze",
-        description="run dttlint + dttcheck + dttsan with one merged "
-                    "exit code")
+        description="run dttlint + dttcheck + dttsan + dttperf with "
+                    "one merged exit code")
     ap.add_argument("--json", action="store_true",
                     help="emit one merged machine-readable JSON object")
     ap.add_argument("--skip", action="append", default=[],
@@ -81,7 +92,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     runners = {"dttlint": _run_dttlint, "dttcheck": _run_dttcheck,
-               "dttsan": _run_dttsan}
+               "dttsan": _run_dttsan, "dttperf": _run_dttperf}
     merged: dict = {}
     ok = True
     for name in ANALYZERS:

@@ -181,6 +181,28 @@ def _state(model, opt):
 # -------------------------------------------------- the generic builder
 
 
+def ledger_config(mode: str = "dp", *, data_ways: int = 1,
+                  model_axis: int = 1, zero_level: int = 0,
+                  virtual_stages: int = 1, microbatches: int = 0,
+                  pp_schedule: str = "auto", zero_overlap: bool = False,
+                  zero_bucket_mb: float = 4.0, **_ignored) -> dict:
+    """Normalize a parallel-layout config to the canonical
+    ``utils/resources.comm_ledger`` kwargs — the ONE normalization
+    (clamping, ``zeroN`` -> level) shared by the scenario builders here
+    and the ``tools/dttperf`` step-time predictor, so the layout the
+    predictor prices is byte-identical to the one the builders trace."""
+    data_ways = max(1, int(data_ways))
+    model_axis = max(1, int(model_axis))
+    if mode.startswith("zero"):
+        zero_level = zero_level or int(mode[4:] or 0)
+    return dict(mode=mode, data_ways=data_ways, model_axis=model_axis,
+                zero_level=int(zero_level),
+                virtual_stages=max(1, int(virtual_stages)),
+                microbatches=int(microbatches), pp_schedule=pp_schedule,
+                zero_overlap=bool(zero_overlap),
+                zero_bucket_mb=float(zero_bucket_mb or 4.0))
+
+
 def build_from_config(model, optimizer, batch_size: int, *,
                       mode: str = "dp", data_ways: int = 1,
                       model_axis: int = 1, zero_level: int = 0,
@@ -204,19 +226,22 @@ def build_from_config(model, optimizer, batch_size: int, *,
         MODEL_AXIS,
     )
 
-    data_ways = max(1, int(data_ways))
-    model_axis = max(1, int(model_axis))
-    if mode.startswith("zero"):
-        zero_level = zero_level or int(mode[4:] or 0)
+    lcfg = ledger_config(
+        mode, data_ways=data_ways, model_axis=model_axis,
+        zero_level=zero_level, virtual_stages=virtual_stages,
+        microbatches=microbatches, pp_schedule=pp_schedule,
+        zero_overlap=zero_overlap, zero_bucket_mb=zero_bucket_mb)
+    data_ways = lcfg["data_ways"]
+    model_axis = lcfg["model_axis"]
+    zero_level = lcfg["zero_level"]
+    virtual_stages = lcfg["virtual_stages"]
+    zero_overlap = lcfg["zero_overlap"]
+    zero_bucket_mb = lcfg["zero_bucket_mb"]
     model_name = model_name or type(model).__name__
     name = name or f"{mode}/{model_name}"
     batch = make_batch(model, int(batch_size))
     batch_axes = [(DATA_AXIS,), (DATA_AXIS,)]
-    ledger_kwargs = None if grad_transform is not None else dict(
-        mode=mode, data_ways=data_ways, model_axis=model_axis,
-        zero_level=zero_level, virtual_stages=virtual_stages,
-        microbatches=microbatches, pp_schedule=pp_schedule,
-        zero_overlap=zero_overlap, zero_bucket_mb=zero_bucket_mb)
+    ledger_kwargs = None if grad_transform is not None else dict(lcfg)
     common = dict(model=model, optimizer=optimizer, mode=mode,
                   model_name=model_name, batch_size=int(batch_size),
                   ledger_kwargs=ledger_kwargs, name=name)
@@ -445,60 +470,80 @@ def _canonical(mode: str, model_name: str, *, clip: bool = False,
         **cfg)
 
 
-#: the matrix. Names are stable finding-key material; the full run is
-#: the repo gate, --mode/--model filter for bring-up.
-SCENARIOS: tuple = (
-    Scenario("dp/deep_cnn", "dp", "deep_cnn",
-             lambda: _canonical("dp", "deep_cnn")),
-    Scenario("dp/mlp", "dp", "mlp", lambda: _canonical("dp", "mlp")),
-    Scenario("dp_eval/deep_cnn", "dp", "deep_cnn",
-             lambda: _build_eval("dp", "deep_cnn")),
-    Scenario("zero1/deep_cnn", "zero1", "deep_cnn",
-             lambda: _canonical("zero1", "deep_cnn", zero_level=1)),
-    Scenario("zero1_overlap/deep_cnn", "zero1", "deep_cnn",
-             lambda: _canonical("zero1", "deep_cnn", zero_level=1,
-                                zero_overlap=True, zero_bucket_mb=0.25,
-                                name="zero1_overlap/deep_cnn")),
-    Scenario("zero3/deep_cnn", "zero3", "deep_cnn",
-             lambda: _canonical("zero3", "deep_cnn", zero_level=3)),
-    Scenario("zero3_overlap/deep_cnn", "zero3", "deep_cnn",
-             lambda: _canonical("zero3", "deep_cnn", zero_level=3,
-                                zero_overlap=True, zero_bucket_mb=0.25,
-                                name="zero3_overlap/deep_cnn")),
-    Scenario("zero1_clip/deep_cnn", "zero1", "deep_cnn",
-             lambda: _canonical("zero1", "deep_cnn", zero_level=1,
-                                clip=True)),
-    Scenario("zero3_eval/deep_cnn", "zero3", "deep_cnn",
-             lambda: _build_eval("zero3", "deep_cnn")),
-    Scenario("pp_gpipe/lm", "pp", "lm",
-             lambda: _canonical("pp", "lm", model_axis=2, microbatches=4,
-                                pp_schedule="gpipe",
-                                name="pp_gpipe/lm")),
-    Scenario("pp_interleaved/lm", "pp", "lm",
-             lambda: _canonical("pp", "lm", model_axis=2, microbatches=4,
-                                virtual_stages=2,
-                                pp_schedule="interleaved",
-                                name="pp_interleaved/lm")),
-    Scenario("pp_zb/lm", "pp", "lm",
-             lambda: _canonical("pp", "lm", model_axis=2, microbatches=4,
-                                pp_schedule="zb", name="pp_zb/lm")),
-    Scenario("pp_clip/lm", "pp", "lm",
-             lambda: _canonical("pp", "lm", model_axis=2, microbatches=4,
-                                pp_schedule="gpipe", clip=True,
-                                name="pp_clip/lm")),
-    Scenario("tp/deep_cnn", "tp", "deep_cnn",
-             lambda: _canonical("tp", "deep_cnn", model_axis=2)),
-    Scenario("ep/lm_moe", "ep", "lm_moe",
-             lambda: _canonical("ep", "lm_moe", model_axis=2)),
-    Scenario("ep_clip/lm_moe", "ep", "lm_moe",
-             lambda: _canonical("ep", "lm_moe", model_axis=2, clip=True,
-                                name="ep_clip/lm_moe")),
-    Scenario("ep_eval/lm_moe", "ep", "lm_moe",
-             lambda: _build_eval("ep", "lm_moe")),
-    Scenario("sp/lm", "sp", "lm",
-             lambda: _canonical("sp", "lm", model_axis=2)),
-    Scenario("sp_eval/lm", "sp", "lm", lambda: _build_eval("sp", "lm")),
-    Scenario("ps/deep_cnn", "ps", "deep_cnn",
-             lambda: _canonical("ps", "deep_cnn", data_ways=1,
-                                batch_size=32)),
+#: the canonical (mode x model x layout) matrix as pure DATA — the one
+#: cell table both proof planes consume: ``SCENARIOS`` below builds a
+#: real TraceTarget per cell (spatial proofs, needs the CPU mesh), and
+#: ``tools/dttperf`` prices the same train cells chip-free (temporal
+#: predictions; eval cells have no training ledger and clip cells are
+#: deliberately unpriced, so dttperf skips both). Names are stable
+#: finding-key material for BOTH analyzers.
+CANONICAL_CELLS: tuple = (
+    dict(name="dp/deep_cnn", mode="dp", model_name="deep_cnn"),
+    dict(name="dp/mlp", mode="dp", model_name="mlp"),
+    dict(name="dp_eval/deep_cnn", mode="dp", model_name="deep_cnn",
+         kind="eval"),
+    dict(name="zero1/deep_cnn", mode="zero1", model_name="deep_cnn",
+         cfg=dict(zero_level=1)),
+    dict(name="zero1_overlap/deep_cnn", mode="zero1",
+         model_name="deep_cnn",
+         cfg=dict(zero_level=1, zero_overlap=True, zero_bucket_mb=0.25)),
+    dict(name="zero3/deep_cnn", mode="zero3", model_name="deep_cnn",
+         cfg=dict(zero_level=3)),
+    dict(name="zero3_overlap/deep_cnn", mode="zero3",
+         model_name="deep_cnn",
+         cfg=dict(zero_level=3, zero_overlap=True, zero_bucket_mb=0.25)),
+    dict(name="zero1_clip/deep_cnn", mode="zero1", model_name="deep_cnn",
+         clip=True, cfg=dict(zero_level=1)),
+    dict(name="zero3_eval/deep_cnn", mode="zero3",
+         model_name="deep_cnn", kind="eval"),
+    dict(name="pp_gpipe/lm", mode="pp", model_name="lm",
+         cfg=dict(model_axis=2, microbatches=4, pp_schedule="gpipe")),
+    dict(name="pp_interleaved/lm", mode="pp", model_name="lm",
+         cfg=dict(model_axis=2, microbatches=4, virtual_stages=2,
+                  pp_schedule="interleaved")),
+    dict(name="pp_zb/lm", mode="pp", model_name="lm",
+         cfg=dict(model_axis=2, microbatches=4, pp_schedule="zb")),
+    dict(name="pp_clip/lm", mode="pp", model_name="lm", clip=True,
+         cfg=dict(model_axis=2, microbatches=4, pp_schedule="gpipe")),
+    dict(name="tp/deep_cnn", mode="tp", model_name="deep_cnn",
+         cfg=dict(model_axis=2)),
+    dict(name="ep/lm_moe", mode="ep", model_name="lm_moe",
+         cfg=dict(model_axis=2)),
+    dict(name="ep_clip/lm_moe", mode="ep", model_name="lm_moe",
+         clip=True, cfg=dict(model_axis=2)),
+    dict(name="ep_eval/lm_moe", mode="ep", model_name="lm_moe",
+         kind="eval"),
+    dict(name="sp/lm", mode="sp", model_name="lm",
+         cfg=dict(model_axis=2)),
+    dict(name="sp_eval/lm", mode="sp", model_name="lm", kind="eval"),
+    dict(name="ps/deep_cnn", mode="ps", model_name="deep_cnn",
+         cfg=dict(data_ways=1, batch_size=32)),
 )
+
+
+def cell_layout(cell: dict, n_devices: int = N_DEVICES) -> dict:
+    """The fully-resolved ledger/layout kwargs for one TRAIN cell —
+    exactly what ``_canonical`` hands ``build_from_config``, computed
+    WITHOUT building anything (chip-free; the dttperf predictor prices
+    these). Resolves the same defaults: ``data_ways`` fills the mesh
+    left over by ``model_axis``."""
+    cfg = dict(cell.get("cfg") or {})
+    cfg.pop("batch_size", None)
+    data = cfg.pop("data_ways", n_devices // cfg.get("model_axis", 1))
+    return ledger_config(cell["mode"], data_ways=data, **cfg)
+
+
+def _build_cell(cell: dict) -> TraceTarget:
+    if cell.get("kind") == "eval":
+        return _build_eval(cell["mode"], cell["model_name"])
+    return _canonical(cell["mode"], cell["model_name"],
+                      clip=bool(cell.get("clip")), name=cell["name"],
+                      **dict(cell.get("cfg") or {}))
+
+
+#: the matrix. One Scenario per canonical cell; the full run is the
+#: repo gate, --mode/--model filter for bring-up.
+SCENARIOS: tuple = tuple(
+    Scenario(c["name"], c["mode"], c["model_name"],
+             (lambda c=c: _build_cell(c)))
+    for c in CANONICAL_CELLS)

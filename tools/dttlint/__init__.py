@@ -42,6 +42,11 @@ docs/ARCHITECTURE.md "Static analysis"):
                            site is discoverable by the dttsan thread
                            inventory (the concurrency layer's closure
                            rule)
+  DTT011 perf-coverage     every public bench phase is dttperf-
+                           resolvable — fact-covered (PHASE_FACTS, so
+                           DTP002 enforces its facts non-null) or
+                           exempted with a stated reason (the
+                           performance layer's closure rule)
 
 Run it: ``python -m tools.dttlint [--json] [--baseline PATH] [--fix]``.
 Exit 0 = no non-baselined findings and no stale suppressions; nonzero

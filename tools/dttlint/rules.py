@@ -962,6 +962,103 @@ def rule_inventory_coverage(index) -> list:
 rule_inventory_coverage.rule_id = "DTT010"
 
 
+# ----------------------------------------------- DTT011 perf-coverage
+
+
+_DTTPERF_PREFIX = "tools/dttperf"
+
+
+def _perf_coverage_tables(index) -> tuple:
+    """The string keys of every ``PHASE_FACTS`` / ``PHASE_EXEMPT``
+    top-level dict literal under ``tools/dttperf/`` — extracted from
+    the AST (not imported: the linter must see exactly what the walk
+    set SAYS, the same discipline as every other rule). Returns
+    (facts_keys, exempt_with_reason, exempt_bare)."""
+    facts: set = set()
+    exempt: set = set()
+    bare: set = set()
+    for rel, tree in index.trees.items():
+        if not rel.startswith(_DTTPERF_PREFIX):
+            continue
+        for node in tree.body:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            names = {t.id for t in targets if isinstance(t, ast.Name)}
+            if not names & {"PHASE_FACTS", "PHASE_EXEMPT"} or \
+                    not isinstance(node.value, ast.Dict):
+                continue
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    continue
+                if "PHASE_FACTS" in names:
+                    facts.add(k.value)
+                elif isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str) and v.value.strip():
+                    exempt.add(k.value)
+                else:
+                    bare.add(k.value)
+    return facts, exempt, bare
+
+
+def rule_perf_coverage(index) -> list:
+    """DTT011: every public bench phase must be dttperf-RESOLVABLE —
+    either fact-covered (a ``PHASE_FACTS`` row: DTP002 then enforces
+    its facts non-null in every record) or explicitly exempted with a
+    stated reason (a ``PHASE_EXEMPT`` row) — the AST and performance
+    layers stay closed under extension (the r23 twin of DTT009/DTT010):
+    a new phase in neither table is a measurement the performance
+    contract silently cannot see — its facts could go null, its rates
+    unbanded, and no pass would notice. Self-disable guarded: bench
+    phases with no tools/dttperf/ sources in the walk set are
+    themselves a finding. A PHASE_EXEMPT entry whose reason is not a
+    non-empty string literal counts as uncovered (an unexplained
+    exemption is an unexplained hole in the contract)."""
+    phases = []  # (rel, name, line)
+    for rel, tree in index.trees.items():
+        if not rel.endswith("bench.py"):
+            continue
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name.endswith("_phase") and \
+                    not node.name.startswith("_"):
+                phases.append((rel, node.name, node.lineno))
+    if not phases:
+        return []  # no bench phases in scope (fixture slices)
+    has_dttperf = any(rel.startswith(_DTTPERF_PREFIX)
+                      for rel in index.trees)
+    if not has_dttperf:
+        return [Finding(
+            "DTT011", "tools::dttperf-missing", _DTTPERF_PREFIX, 0,
+            "the walk set contains bench phases but no tools/dttperf/ "
+            "sources — the perf-coverage rule would silently "
+            "self-disable")]
+    facts, exempt, bare = _perf_coverage_tables(index)
+    out = []
+    for rel, name, line in sorted(phases):
+        if name in facts or name in exempt:
+            continue
+        why = ("is PHASE_EXEMPT but its reason is not a non-empty "
+               "string literal — an unexplained exemption is an "
+               "unexplained hole in the contract"
+               if name in bare else
+               "is in neither PHASE_FACTS nor PHASE_EXEMPT in "
+               "tools/dttperf/ — a phase the performance contract "
+               "cannot see: its facts could go null and its rates "
+               "drift with no pass noticing")
+        out.append(Finding(
+            "DTT011", f"{rel}::{name}", rel, line,
+            f"bench phase {name}() {why}; add a PHASE_FACTS row (and "
+            f"let DTP002 enforce it) or a PHASE_EXEMPT entry with the "
+            f"reason"))
+    return out
+
+
+rule_perf_coverage.rule_id = "DTT011"
+
+
 ALL_RULES = (
     rule_collective_axis,
     rule_ledger_coverage,
@@ -973,4 +1070,5 @@ ALL_RULES = (
     rule_donation_safety,
     rule_traced_coverage,
     rule_inventory_coverage,
+    rule_perf_coverage,
 )

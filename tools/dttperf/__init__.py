@@ -1,0 +1,142 @@
+"""dttperf — the performance-contract analyzer: the proof plane goes
+temporal (r23).
+
+The reference framework validated the distributed program BEFORE it
+ran; this repo reproduced that spirit spatially — dttlint proves what
+the source SAYS, dttcheck proves what the compiler LOWERS, dttsan
+proves what the host THREADS do — but none of them proves TIME, even
+though a verified analytic dual exists for every term of a step-time
+model: ``flops_budget`` (the per-layer FLOPs table), ``comm_ledger``
+(wire bytes, jaxpr-proven byte-exact by dttcheck as of r18, with
+exposed-byte accounting), and the pp schedules' useful-tick
+fractions. dttperf composes those duals into a predicted step time
+per canonical (mode x model) cell —
+
+    max(compute / peak_flops, exposed_comm / bandwidth) + host costs
+
+— and machine-checks the prediction against what the tree MEASURED:
+
+  DTP000 cell-pricing        a cell whose prediction fails to compose
+                             is itself a finding
+  DTP001 record-conformance  every banded bench-record rate must sit
+                             inside the prediction's declared band;
+                             out-of-band = a finding keyed by
+                             (record, phase, mode, model) — "this PR
+                             made the pp step 15% slower" becomes a
+                             named, baselinable regression instead of
+                             silent drift
+  DTP002 fact-coverage       every covered bench phase emits its
+                             analytic facts non-null in EVERY record
+                             (degraded/outage included), and each
+                             predictor term's measured dual is really
+                             emitted — the established bench contract,
+                             now enforced
+  DTP003 budget-conformance  declared wall-time budgets (tier-1 suite
+                             total, per-analyzer runtimes, telemetry
+                             overhead < 2%) are checked against
+                             measured values — pinned, live-clocked
+                             this run, or read from the newest record
+
+Chip-free end to end: predictions are pure Python + ``jax.eval_shape``
+over the SAME canonical cell table dttcheck traces
+(``tools.dttcheck.scenarios.CANONICAL_CELLS`` — one matrix, proven
+spatially there, priced temporally here), at flagship shapes. The
+repo-wide gate budget is <15s. ROADMAP item 1's auto-planner imports
+``predict_step_time`` as its scorer — one cost model, checked two
+ways.
+
+Run it: ``python -m tools.dttperf [--json] [--mode M] [--model M]``.
+Exit 0 = no non-baselined findings and no stale suppressions — the
+shared ``tools/_analysis_common`` contract (suppress by stable key,
+mandatory reason, stale entries fail, the baseline only shrinks).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from tools._analysis_common import (  # noqa: E402
+    REPO_ROOT,
+    AnalysisResult,
+    Finding,  # noqa: F401 — re-exported for the passes/tests
+    apply_baseline,
+    load_baseline as _load_baseline,
+)
+from tools.dttperf.model import predict_step_time  # noqa: F401,E402
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+ALL_PASSES = ("DTP000", "DTP001", "DTP002", "DTP003")
+
+PerfResult = AnalysisResult
+
+
+def load_baseline(path: str | None = None) -> list[dict]:
+    return _load_baseline(path, DEFAULT_BASELINE)
+
+
+def run_perf(baseline_path: str | None = None, *, modes=None,
+             models=None, root: str = REPO_ROOT, records=None,
+             budgets_path: str | None = None,
+             bench_path: str | None = None) -> PerfResult:
+    """The one entry point (CLI, tier-1 gate, bench perfcheck_phase).
+    ``modes``/``models`` filter the cell matrix for bring-up — a
+    filtered run prices only those cells and SKIPS the record/budget
+    passes (their findings key off the whole corpus, so a partial run
+    must not charge their stale entries; the unfiltered run stays the
+    court where dead suppressions fail). ``records`` injects a record
+    corpus (tests), ``budgets_path``/``bench_path`` override the
+    checked-in tables."""
+    from tools.dttperf import passes, records as rec_mod, scenarios
+
+    t0 = time.perf_counter()
+    filtered = bool(modes or models)
+    found: list = []
+    cell_rows, cell_findings, matrix_s = scenarios.build_matrix(
+        modes=modes, models=models)
+    found += cell_findings
+    rate_rows: list = []
+    fact_rows: list = []
+    budget_rows: list = []
+    ran: tuple = ("DTP000",)
+    if not filtered:
+        recs = records if records is not None \
+            else rec_mod.load_records(root)
+        f1, rate_rows = passes.pass_conformance(recs)
+        f2, fact_rows = passes.pass_fact_coverage(
+            recs, bench_path=bench_path)
+        live = passes.measure_live()
+        live["live:dttperf_matrix"] = matrix_s
+        f3, budget_rows = passes.pass_budgets(
+            passes.load_budgets(budgets_path), recs, live)
+        found += f1 + f2 + f3
+        ran = ALL_PASSES
+    banded = [r for r in rate_rows if r["status"] != "exempt"]
+    in_band = [r for r in banded if r["status"] == "in_band"]
+    report = {
+        "cells": cell_rows,
+        "scenarios_proven": len(cell_rows),
+        "modes_priced": sorted({r["mode"] for r in cell_rows}),
+        "rate_checks": rate_rows,
+        "in_band_pct": (round(100.0 * len(in_band) / len(banded), 1)
+                        if banded else 100.0),
+        "fact_coverage": fact_rows,
+        "budgets": budget_rows,
+        "matrix_time_s": round(matrix_s, 3),
+        "time_s": round(time.perf_counter() - t0, 3),
+    }
+    result = apply_baseline(found, load_baseline(baseline_path),
+                            rules=ran, report=report)
+    if filtered:
+        # the dttcheck contract: a filtered bring-up run only charges
+        # stale against cells that RAN (matrix DTP000 keys are
+        # exactly "build:<cell>"); the unfiltered run stays the court
+        # where dead entries fail
+        ran_keys = {f"DTP000:build:{r['cell']}" for r in cell_rows}
+        result.stale = [s for s in result.stale if s in ran_keys]
+    return result

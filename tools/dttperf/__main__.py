@@ -1,0 +1,87 @@
+"""CLI: ``python -m tools.dttperf [--json] [--mode M] [--model M]
+[--baseline PATH] [--matrix]``.
+
+Exit status is the shared analyzer contract (dttlint/dttcheck/dttsan):
+0 when every cell prices clean, every banded record rate sits in its
+band, the fact-coverage and budget closures hold, and no suppression
+is stale; 1 otherwise.
+
+``--mode`` / ``--model`` filter the cell matrix for bring-up (a
+filtered run prices cells only — the record/budget passes need the
+whole corpus). ``--matrix`` prints the per-cell prediction table
+(step time, bound term, predicted ceiling) — the human-readable view
+of what the contract promises.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# tools/ convention: runnable as a script too
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.dttperf import DEFAULT_BASELINE, run_perf  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dttperf",
+        description="dttperf — the performance-contract analyzer "
+                    "(passes DTP000-DTP003; see docs/ARCHITECTURE.md "
+                    "'Performance contracts')")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON object")
+    ap.add_argument("--mode", action="append", default=None,
+                    help="restrict to one parallel mode (repeatable): "
+                         "dp zero1 zero3 pp tp ep sp ps")
+    ap.add_argument("--model", action="append", default=None,
+                    help="restrict to one model (repeatable): "
+                         "deep_cnn mlp lm lm_moe")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression file (default: the checked-in "
+                         "tools/dttperf/baseline.json)")
+    ap.add_argument("--matrix", action="store_true",
+                    help="print the per-cell prediction table")
+    args = ap.parse_args(argv)
+
+    result = run_perf(args.baseline, modes=args.mode, models=args.model)
+
+    if args.json:
+        print(json.dumps(result.to_json()))
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(f.format())
+    for key in result.stale:
+        print(f"{args.baseline}: STALE suppression {key} — the finding "
+              f"no longer exists; delete the entry (the baseline only "
+              f"shrinks)")
+    rep = result.report
+    if args.matrix:
+        print(f"{'cell':<24} {'chips':>5} {'batch':>6} "
+              f"{'step ms':>9} {'ex/s/chip':>11} {'bound':<8} "
+              f"{'useful':>6}")
+        for r in rep.get("cells", []):
+            print(f"{r['cell']:<24} {r['chips']:>5} "
+                  f"{r['global_batch']:>6} {r['step_time_ms']:>9.3f} "
+                  f"{r['examples_per_sec_per_chip']:>11,.0f} "
+                  f"{r['bound']:<8} {r['useful_fraction']:>6.3f}")
+    n_budget_ok = sum(1 for b in rep.get("budgets", [])
+                      if b["status"] == "ok")
+    print(f"dttperf: {len(result.findings)} finding(s), "
+          f"{len(result.baselined)} baselined, "
+          f"{len(result.stale)} stale suppression(s); "
+          f"{rep.get('scenarios_proven')} cell(s) priced, "
+          f"modes: {rep.get('modes_priced')}, "
+          f"records in-band: {rep.get('in_band_pct')}%, "
+          f"budgets ok: {n_budget_ok}/{len(rep.get('budgets', []))}, "
+          f"{rep.get('time_s')}s")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,329 @@
+"""The four dttperf passes. Each returns (findings, report_rows);
+the runner in ``__init__`` assembles them into one AnalysisResult.
+
+  DTP000 cell-pricing       a perf cell that fails to compose its
+                            prediction is itself a finding (emitted by
+                            scenarios.build_matrix — a cell nobody can
+                            price is a cell no record can be banded
+                            against)
+  DTP001 record-conformance every banded measured rate must sit inside
+                            the prediction's declared band; the finding
+                            key is (record, phase, mode, model), so a
+                            NEW out-of-band record is a fresh finding
+                            even when an older one is baselined
+  DTP002 fact-coverage      every covered bench phase's facts are
+                            non-null in every record the phase appears
+                            in (null allowed only next to the phase's
+                            error key), the phase is wired into BOTH
+                            _run_phases and degraded_record, and the
+                            step-time model's term->fact closure holds
+  DTP003 budget-conformance declared wall-time/overhead budgets are
+                            checked against measured values — pinned,
+                            live-measured this run, or read from the
+                            newest record that carries them
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import time
+
+from tools._analysis_common import REPO_ROOT, Finding
+
+from tools.dttperf import records as rec_mod
+
+
+# ------------------------------------------------ DTP001 conformance
+
+
+def pass_conformance(records: list, hardware="v5lite") -> tuple:
+    """Band every measured rate in every record against the predictor's
+    ceiling for that rate's (phase, mode, model) identity."""
+    from tools.dttperf.model import predict_step_time
+    from tools.dttperf.scenarios import flagship_model
+
+    findings: list = []
+    rows: list = []
+    pred_cache: dict = {}
+    for rec in records:
+        parsed = rec["parsed"]
+        for chk in rec_mod.RATE_CHECKS:
+            val = parsed.get(chk["key"])
+            if val is None:
+                continue  # absent or null: DTP002's beat, not DTP001's
+            if "metric" in chk and parsed.get("metric") != chk["metric"]:
+                continue
+            ident = f"{chk['phase']}:{chk['mode']}:{chk['model']}"
+            if chk.get("link_bound"):
+                rows.append({"record": rec["stem"], "check": ident,
+                             "key": chk["key"], "measured": val,
+                             "status": "exempt",
+                             "why": chk["link_bound"]})
+                continue
+            n_chips = int(parsed.get("n_chips") or 1)
+            cache_key = (ident, n_chips)
+            if cache_key not in pred_cache:
+                try:
+                    pred_cache[cache_key] = predict_step_time(
+                        dict(mode=chk["mode"], data_ways=n_chips),
+                        flagship_model(chk["model"]), n_chips,
+                        global_batch=chk["per_chip_batch"] * n_chips,
+                        hardware=hardware)
+                except Exception as e:  # noqa: BLE001
+                    findings.append(Finding(
+                        "DTP000", f"build:{ident}", "tools/dttperf", 0,
+                        f"[{ident}] conformance prediction failed to "
+                        f"PRICE: {type(e).__name__}: {e}"))
+                    pred_cache[cache_key] = None
+            pred = pred_cache[cache_key]
+            if pred is None:
+                continue
+            ceiling = pred["examples_per_sec_per_chip"]
+            ratio = float(val) / ceiling if ceiling > 0 else float("inf")
+            lo, hi = chk["band"]
+            in_band = lo <= ratio <= hi
+            rows.append({"record": rec["stem"], "check": ident,
+                         "key": chk["key"], "measured": val,
+                         "predicted_ceiling": round(ceiling, 1),
+                         "ratio": round(ratio, 4),
+                         "band": [lo, hi],
+                         "status": "in_band" if in_band else "OUT"})
+            if not in_band:
+                why = ("faster than the analytic roof: accounting bug"
+                       if ratio > hi
+                       else "a performance regression or a "
+                            "mis-declared band")
+                findings.append(Finding(
+                    "DTP001", f"band:{rec['stem']}:{ident}",
+                    rec["path"], 0,
+                    f"[{ident}] measured {chk['key']}={val:,.1f} is "
+                    f"{ratio:.3f} of the predicted ceiling "
+                    f"{ceiling:,.1f} ex/s/chip — outside the declared "
+                    f"band [{lo}, {hi}] ({why})"))
+    return findings, rows
+
+
+# ---------------------------------------------- DTP002 fact-coverage
+
+
+def _bench_tree(bench_path: str):
+    with open(bench_path, encoding="utf-8") as f:
+        return ast.parse(f.read())
+
+
+def _called_names(fn_node) -> set:
+    out = set()
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                out.add(f.attr)
+    return out
+
+
+def _str_constants(node) -> set:
+    """Every string literal in the AST subtree — the fact keys a
+    phase can actually emit (dict keys, subscript assignments)."""
+    return {sub.value for sub in ast.walk(node)
+            if isinstance(sub, ast.Constant)
+            and isinstance(sub.value, str)}
+
+
+def pass_fact_coverage(records: list,
+                       bench_path: str | None = None) -> tuple:
+    """Three closures: (a) every PHASE_FACTS phase exists in bench.py,
+    is wired into BOTH ``_run_phases`` and ``degraded_record``, and
+    every fact key it owes appears as a string literal inside that
+    phase's OWN body (a mention elsewhere — a comment, another
+    phase's dict — does not emit the fact); (b) in every
+    record where a phase appears, its facts are non-null unless the
+    phase's error key is present; (c) MODEL_CONSUMES — each predictor
+    term's measured dual is emitted by a covered phase."""
+    bench_path = bench_path or os.path.join(REPO_ROOT, "bench.py")
+    findings: list = []
+    rows: list = []
+    try:
+        tree = _bench_tree(bench_path)
+    except (OSError, SyntaxError) as e:
+        return [Finding("DTP002", "bench:unreadable", "bench.py", 0,
+                        f"bench.py cannot be parsed for fact-coverage: "
+                        f"{type(e).__name__}: {e}")], rows
+    defs = {n.name: n for n in tree.body
+            if isinstance(n, ast.FunctionDef)}
+    wiring = {name: _called_names(defs[name]) for name in
+              ("_run_phases", "degraded_record") if name in defs}
+
+    for phase, spec in sorted(rec_mod.PHASE_FACTS.items()):
+        if phase not in defs:
+            findings.append(Finding(
+                "DTP002", f"phase:{phase}:missing", "bench.py", 0,
+                f"PHASE_FACTS covers {phase}() but bench.py defines no "
+                f"such phase — the coverage table drifted from the "
+                f"tree"))
+            continue
+        for where, called in wiring.items():
+            if phase not in called:
+                kind = ("degraded/outage"
+                        if where == "degraded_record" else "healthy")
+                findings.append(Finding(
+                    "DTP002", f"phase:{phase}:unwired:{where}",
+                    "bench.py", defs[phase].lineno,
+                    f"{phase}() is fact-covered but not invoked from "
+                    f"{where}() — its facts would go null in {kind} "
+                    f"records, breaking the non-null contract DTP002 "
+                    f"enforces"))
+        emitted = _str_constants(defs[phase])
+        for key in spec["keys"]:
+            if key not in emitted:
+                findings.append(Finding(
+                    "DTP002", f"phase:{phase}:unemitted:{key}",
+                    "bench.py", defs[phase].lineno,
+                    f"{phase}() owes fact {key!r} but no string "
+                    f"literal in its body emits that key — the fact "
+                    f"cannot reach any record from the phase that "
+                    f"owns it"))
+
+    for rec in records:
+        parsed = rec["parsed"]
+        for phase, spec in sorted(rec_mod.PHASE_FACTS.items()):
+            present = [k for k in spec["keys"] if k in parsed]
+            has_err = spec["error_key"] in parsed
+            if not present and not has_err:
+                continue  # the record predates the phase
+            nulls = [k for k in spec["keys"] if parsed.get(k) is None]
+            status = "ok"
+            if nulls and not has_err:
+                status = "VIOLATION"
+                for k in nulls:
+                    findings.append(Finding(
+                        "DTP002", f"facts:{rec['stem']}:{phase}:{k}",
+                        rec["path"], 0,
+                        f"record {rec['stem']} carries {phase}() facts "
+                        f"but {k!r} is "
+                        f"{'null' if k in parsed else 'missing'} with "
+                        f"no {spec['error_key']!r} — the phase broke "
+                        f"the non-null-even-degraded contract "
+                        f"silently"))
+            elif nulls:
+                status = "errored"  # nulls excused by the error key
+            rows.append({"record": rec["stem"], "phase": phase,
+                         "facts": len(spec["keys"]),
+                         "null": len(nulls), "status": status})
+
+    module_emits = _str_constants(tree)
+    for term, phase, key in rec_mod.MODEL_CONSUMES:
+        if phase is not None:
+            spec = rec_mod.PHASE_FACTS.get(phase)
+            if spec is None or key not in spec["keys"]:
+                findings.append(Finding(
+                    "DTP002", f"consumes:{term}:{key}",
+                    "tools/dttperf/records.py", 0,
+                    f"the step-time model's {term!r} term consumes "
+                    f"{key!r} but {phase}() does not emit it under "
+                    f"PHASE_FACTS — the prediction would rest on a "
+                    f"fact no record carries"))
+        elif key not in module_emits:
+            findings.append(Finding(
+                "DTP002", f"consumes:{term}:{key}", "bench.py", 0,
+                f"the step-time model's {term!r} term consumes "
+                f"record-level fact {key!r} but bench.py never emits "
+                f"it"))
+    return findings, rows
+
+
+# -------------------------------------------------- DTP003 budgets
+
+
+def load_budgets(path: str | None = None) -> list[dict]:
+    import json
+
+    path = path or os.path.join(os.path.dirname(os.path.abspath(
+        __file__)), "budgets.json")
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return data.get("budgets", [])
+
+
+def measure_live() -> dict:
+    """The live half of DTP003: wall-clock the analyzers cheap enough
+    to run inside this process (dttlint is pure ast, ~2s). dttcheck's
+    full trace matrix costs ~10s of subprocess and stays PINNED."""
+    out = {}
+    t0 = time.perf_counter()
+    try:
+        from tools.dttlint import run_lint
+
+        run_lint()
+        out["live:dttlint"] = time.perf_counter() - t0
+    except Exception as e:  # noqa: BLE001 — report as unmeasured
+        out["live:dttlint"] = None
+        out["live:dttlint:error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def pass_budgets(budgets: list, records: list, live: dict) -> tuple:
+    """Every declared budget must have a measurement and sit under its
+    limit. Measurement sources: ``pinned`` (the checked-in measured
+    value — re-pinned whenever the quantity is re-measured),
+    ``live:*`` (wall-clocked during THIS run), ``record:<key>`` (the
+    newest bench record carrying the key; a key no record carries yet
+    is reported, not failed — the fact was born after the last chip
+    run)."""
+    findings: list = []
+    rows: list = []
+    for b in budgets:
+        name, limit, source = b["name"], float(b["limit"]), b["source"]
+        measured = None
+        note = ""
+        if source == "pinned":
+            measured = b.get("measured")
+            if measured is None:
+                findings.append(Finding(
+                    "DTP003", f"budget:{name}:unmeasured",
+                    "tools/dttperf/budgets.json", 0,
+                    f"budget {name} (limit {limit}) is declared pinned "
+                    f"but carries no measured value — an unmeasured "
+                    f"budget is an unenforced one"))
+        elif source.startswith("live:"):
+            measured = live.get(source)
+            if measured is None:
+                findings.append(Finding(
+                    "DTP003", f"budget:{name}:unmeasured",
+                    "tools/dttperf/budgets.json", 0,
+                    f"budget {name} (limit {limit}) wants live "
+                    f"measurement {source!r} but none was taken: "
+                    f"{live.get(source + ':error', 'not measured')}"))
+        elif source.startswith("record:"):
+            key = source.split(":", 1)[1]
+            for rec in reversed(records):
+                if rec["parsed"].get(key) is not None:
+                    measured = rec["parsed"][key]
+                    note = f"from {rec['stem']}"
+                    break
+            if measured is None:
+                note = ("no record carries this yet (born after the "
+                        "last chip run)")
+        else:
+            findings.append(Finding(
+                "DTP003", f"budget:{name}:bad-source",
+                "tools/dttperf/budgets.json", 0,
+                f"budget {name} has unknown measurement source "
+                f"{source!r}"))
+        if measured is not None and float(measured) > limit:
+            findings.append(Finding(
+                "DTP003", f"budget:{name}", "tools/dttperf/budgets.json",
+                0,
+                f"budget {name} BLOWN: measured {float(measured):g} > "
+                f"declared limit {limit:g} ({source}"
+                f"{', ' + note if note else ''}) — either the "
+                f"regression goes or the budget is re-justified"))
+        rows.append({"budget": name, "limit": limit,
+                     "measured": measured, "source": source,
+                     "note": note,
+                     "status": ("unmeasured" if measured is None
+                                else ("BLOWN" if float(measured) > limit
+                                      else "ok"))})
+    return findings, rows

@@ -1,0 +1,135 @@
+"""The dttperf cell matrix: flagship-shape predictions over dttcheck's
+canonical (mode x model x layout) cells.
+
+The SAME cell table drives both proof planes:
+``tools.dttcheck.scenarios.CANONICAL_CELLS`` is the one matrix —
+dttcheck builds each cell's REAL train step over the virtual CPU mesh
+and proves it spatially; this module prices each TRAIN cell's flagship
+twin temporally, chip-free (``cell_layout`` resolves the identical
+layout kwargs, so the plan the predictor prices is the plan the
+verifier proved). Eval cells are skipped (no training ledger to price)
+and clip cells are skipped (their clip collectives are deliberately
+unpriced — the same reason dttcheck's ledger pass skips them).
+
+dttcheck traces TINY shapes (tracing cost is Python time); predictions
+must use the FLAGSHIP shapes instead, because DTP001 bands real bench
+records against them and a step-time extrapolated from toy shapes
+would band nothing real. Both are size instantiations of the same
+size-generic cell.
+"""
+
+from __future__ import annotations
+
+import time
+
+#: flagship shapes per model family — the configurations bench.py
+#: actually measures (PER_CHIP_BATCH=2048 headline CNN; the LM phases'
+#: large-vocab config; trace_ops._MEM_MODELS mirrors these).
+FLAGSHIP_SHAPES: dict = {
+    "deep_cnn": dict(image_size=28, channels=1, num_classes=10),
+    "mlp": dict(image_size=28, channels=1, num_classes=10),
+    "resnet20": dict(image_size=32, channels=3, num_classes=10),
+    "lm": dict(vocab_size=32768, seq_len=1024, d_model=256,
+               num_heads=4, num_blocks=4),
+}
+
+#: per-data-shard batch per family (the bench flagship configs:
+#: PER_CHIP_BATCH for the headline CNN, RESNET_PER_CHIP_BATCH, and the
+#: LM phases' token batch).
+FLAGSHIP_BATCH: dict = {
+    "deep_cnn": 2048,
+    "mlp": 2048,
+    "resnet20": 512,
+    "lm": 32,
+    "lm_moe": 32,
+}
+
+
+def flagship_model(model_name: str):
+    """Instantiate one flagship model chip-free (pure Python objects —
+    no params are materialized; ``flops_budget`` reads attributes and
+    ``comm_ledger`` uses ``jax.eval_shape``)."""
+    from distributed_tensorflow_tpu.models import get_model
+
+    if model_name == "lm_moe":
+        from distributed_tensorflow_tpu.parallel.mesh import MODEL_AXIS
+
+        return get_model("lm", **FLAGSHIP_SHAPES["lm"], moe_experts=8,
+                         moe_axis=MODEL_AXIS)
+    return get_model(model_name, **FLAGSHIP_SHAPES[model_name])
+
+
+def perf_cells(modes=None, models=None) -> list[dict]:
+    """The priceable subset of the canonical matrix: every TRAIN cell
+    (no eval twins, no clip variants), with its fully-resolved layout
+    and flagship global batch. ``modes``/``models`` filter for
+    bring-up, mirroring the dttcheck CLI."""
+    from tools.dttcheck.scenarios import (
+        CANONICAL_CELLS,
+        N_DEVICES,
+        cell_layout,
+    )
+
+    out = []
+    for cell in CANONICAL_CELLS:
+        if cell.get("kind") == "eval" or cell.get("clip"):
+            continue
+        if modes and cell["mode"] not in modes:
+            continue
+        if models and cell["model_name"] not in models:
+            continue
+        layout = cell_layout(cell, N_DEVICES)
+        chips = layout["data_ways"] * layout["model_axis"]
+        out.append({
+            "name": cell["name"],
+            "mode": cell["mode"],
+            "model_name": cell["model_name"],
+            "layout": layout,
+            "chips": chips,
+            "global_batch":
+                FLAGSHIP_BATCH[cell["model_name"]] * layout["data_ways"],
+        })
+    return out
+
+
+def build_matrix(modes=None, models=None) -> tuple[list, list, float]:
+    """Price every selected cell. Returns (rows, findings, wall_s):
+    one report row per successfully priced cell, one DTP000 Finding
+    per cell whose prediction failed to COMPOSE (a cell nobody can
+    price is a cell no record can be banded against — dttcheck's
+    DTC000 contract, temporal edition)."""
+    from tools._analysis_common import Finding
+
+    from tools.dttperf.model import predict_step_time
+
+    rows: list = []
+    findings: list = []
+    t0 = time.perf_counter()
+    for cell in perf_cells(modes=modes, models=models):
+        try:
+            model = flagship_model(cell["model_name"])
+            pred = predict_step_time(
+                cell["layout"], model, cell["chips"],
+                global_batch=cell["global_batch"])
+        except Exception as e:  # noqa: BLE001 — a broken cell IS a finding
+            findings.append(Finding(
+                "DTP000", f"build:{cell['name']}", "tools/dttperf", 0,
+                f"[{cell['name']}] perf cell failed to PRICE: "
+                f"{type(e).__name__}: {e}"))
+            continue
+        rows.append({
+            "cell": cell["name"],
+            "mode": cell["mode"],
+            "model": cell["model_name"],
+            "chips": cell["chips"],
+            "global_batch": cell["global_batch"],
+            "step_time_ms": round(pred["step_time_s"] * 1e3, 4),
+            "examples_per_sec_per_chip":
+                round(pred["examples_per_sec_per_chip"], 1),
+            "bound": pred["bound"],
+            "useful_fraction": pred["useful_fraction"],
+            "compute_ms": round(pred["compute_s"] * 1e3, 4),
+            "comm_ms": round(pred["comm_s"] * 1e3, 4),
+            "comm_exposed_bytes": pred["comm_exposed_bytes_per_step"],
+        })
+    return rows, findings, time.perf_counter() - t0

@@ -53,6 +53,15 @@ compiled CPU HLO), one row per collective equation with family, mesh
 axes, trips, and wire bytes — what the analytic ledger row SHOULD say,
 measured.
 
+``--predict MODEL D [--mode M] [--batch B]`` prints the PREDICTED STEP
+TIME for one (mode, model) cell — the sixth sibling of
+--mem/--flops/--comm/--jaxpr/--threads: memory, compute, the wire, the
+wire as lowered, the thread plane, and now TIME. The same
+``tools.dttperf.predict_step_time`` composition the performance
+contract bands bench records against (max(compute/peak,
+exposed_comm/bandwidth) + host costs), term by term with each term's
+machine-checked provenance — what the DTP001 ceiling IS, shown built.
+
 ``--threads`` prints the discovered THREAD INVENTORY — every
 concurrent entry point in the tree (Thread/Timer construction sites,
 threaded-server handler classes, excepthook/atexit/signal hooks, crash
@@ -62,14 +71,17 @@ model, chip-free). The fifth sibling: memory, compute, the wire, the
 wire as lowered, and the host thread plane.
 
 The static-analysis siblings of this whole printer family are
-``python -m tools.dttlint`` (AST invariants, rules DTT001-DTT010),
+``python -m tools.dttlint`` (AST invariants, rules DTT001-DTT011),
 ``python -m tools.dttcheck`` (jaxpr-level proofs, passes DTC001-DTC004
-— the ledger/SPMD verifier whose inventory --jaxpr prints), and
+— the ledger/SPMD verifier whose inventory --jaxpr prints),
 ``python -m tools.dttsan`` (the host-plane concurrency analyzer whose
-inventory --threads prints; passes SAN001-SAN004): where
---schedule/--mem/--flops/--comm/--jaxpr/--threads PRINT the tree's
-static facts, those three ENFORCE them (docs/ARCHITECTURE.md "Static
-analysis", "Jaxpr verification", and "Concurrency analysis").
+inventory --threads prints; passes SAN001-SAN004), and ``python -m
+tools.dttperf`` (the performance-contract analyzer whose prediction
+--predict prints; passes DTP000-DTP003): where
+--schedule/--mem/--flops/--comm/--jaxpr/--threads/--predict PRINT the
+tree's static facts, those four ENFORCE them (docs/ARCHITECTURE.md
+"Static analysis", "Jaxpr verification", "Concurrency analysis", and
+"Performance contracts").
 
 Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
        python tools/trace_ops.py --schedule K M [V] [gpipe|interleaved|zb]
@@ -81,9 +93,11 @@ Usage: python tools/trace_ops.py /tmp/profile-dir [top_n]
                                  [--zero_overlap] [--bucket_mb N]
        python tools/trace_ops.py --jaxpr MODEL D [--mode M]
                                  [--model_axis K] [--batch B]
+       python tools/trace_ops.py --predict MODEL D [--mode M] [--batch B]
        python -m tools.dttlint [--json] [--baseline PATH] [--fix]
        python -m tools.dttcheck [--json] [--mode M] [--model M]
        python -m tools.dttsan [--json] [--baseline PATH] [--threads]
+       python -m tools.dttperf [--json] [--mode M] [--model M]
        python -m tools.analyze [--json]
 """
 
@@ -430,6 +444,57 @@ def print_jaxpr_inventory(model_name: str, d: int, mode: str = "dp",
         print(f"  {fam} over {','.join(axes)}: {_fmt_bytes(bytes_)}")
 
 
+def print_predict(model_name: str, d: int, mode: str = "dp",
+                  batch: int | None = None) -> None:
+    """Print the predicted step time for one (mode, model) cell — the
+    same ``tools.dttperf.predict_step_time`` composition the
+    performance contract (DTP001) bands bench records against, shown
+    term by term with each term's provenance. Chip-free (pure Python +
+    ``jax.eval_shape``). The sixth sibling: memory, compute, the wire,
+    the wire as lowered, the thread plane, and now TIME."""
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.dttperf import predict_step_time
+    from tools.dttperf.scenarios import FLAGSHIP_BATCH, flagship_model
+
+    if model_name not in FLAGSHIP_BATCH:
+        raise SystemExit(f"--predict: unknown model {model_name!r}; "
+                         f"available: {sorted(FLAGSHIP_BATCH)}")
+    known = ("dp", "zero1", "zero3", "pp", "tp", "ep", "sp", "ps")
+    if mode not in known:
+        raise SystemExit(f"--predict: unknown mode {mode!r}; one of "
+                         f"{', '.join(known)}")
+    model_ways = 2 if mode in ("pp", "tp", "ep", "sp") else 1
+    data_ways = max(1, d // model_ways)
+    plan = dict(mode=mode, data_ways=data_ways, model_axis=model_ways,
+                zero_level=int(mode[4:]) if mode.startswith("zero")
+                else 0)
+    if batch is None:
+        batch = FLAGSHIP_BATCH[model_name] * data_ways
+    pred = predict_step_time(plan, flagship_model(model_name), d,
+                             global_batch=batch)
+
+    print(f"predicted step time — model={model_name} mode={mode} D={d} "
+          f"global_batch={pred['global_batch']} "
+          f"hardware={pred['hardware']} (ceiling: spec peak, analytic "
+          f"terms; DTP001 bands measured rates against this)")
+    print(f"{'term':<14} {'seconds':>12}  source")
+    for t in pred["terms"]:
+        print(f"{t['term']:<14} {t['seconds']:>12.6f}  {t['source']}")
+    us = pred["useful_fraction"]
+    extra = f", pp useful fraction {us:.3f}" if us < 1.0 else ""
+    print(f"\nstep = max(compute, exposed_comm) + host = "
+          f"{pred['step_time_s'] * 1e3:.3f} ms ({pred['bound']}-bound"
+          f"{extra})")
+    print(f"flops/step {pred['flops_per_step']:,}; wire "
+          f"{pred['comm_bytes_per_step']:,} B/step "
+          f"({pred['comm_exposed_bytes_per_step']:,} exposed)")
+    print(f"ceiling: {pred['examples_per_sec']:,.0f} examples/s "
+          f"({pred['examples_per_sec_per_chip']:,.0f} per chip)")
+
+
 def print_threads() -> None:
     """Print the discovered thread inventory — every concurrent entry
     point in the tree (Thread/Timer sites, threaded-server handler
@@ -516,6 +581,20 @@ if __name__ == "__main__":
         print_jaxpr_inventory(rest[0],
                               int(rest[1]) if len(rest) > 1 else 8,
                               mode, model_axis, batch)
+    elif sys.argv[1] == "--predict":
+        rest = sys.argv[2:]
+        mode = "dp"
+        batch = None
+        if "--mode" in rest:
+            i = rest.index("--mode")
+            mode = rest[i + 1]
+            rest = rest[:i] + rest[i + 2:]
+        if "--batch" in rest:
+            i = rest.index("--batch")
+            batch = int(rest[i + 1])
+            rest = rest[:i] + rest[i + 2:]
+        print_predict(rest[0], int(rest[1]) if len(rest) > 1 else 8,
+                      mode, batch)
     elif sys.argv[1] == "--comm":
         rest = sys.argv[2:]
         model_axis = 2
